@@ -1,0 +1,24 @@
+"""deeplearning4j_tpu — a TPU-native deep learning framework.
+
+A from-scratch JAX/XLA rebuild of the capabilities of early Deeplearning4j
+(reference: pkthebud/deeplearning4j v0.0.3.3.5.alpha2). The reference's
+Java/ND4J architecture (INDArray facade over jblas/jcublas, MultiLayerNetwork,
+Solver/line-search optimizers, IterativeReduce data parallelism) is re-designed
+TPU-first here:
+
+- compute path: jax.numpy / lax under ``jit``, bfloat16-friendly, static shapes
+- autodiff: ``jax.grad`` replaces hand-written ``backwardGradient`` chains
+  (ref: nn/layers/BaseLayer.java:115)
+- data parallelism: in-graph XLA collectives (psum over a ``jax.sharding.Mesh``)
+  replace driver-side parameter averaging
+  (ref: spark/impl/multilayer/SparkDl4jMultiLayer.java:157-203)
+- RNG: stateless threaded PRNG keys replace the global mutable RNG
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.nn.conf import (  # noqa: F401
+    NeuralNetConfiguration,
+    MultiLayerConfiguration,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: F401

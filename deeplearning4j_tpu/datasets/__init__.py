@@ -1,0 +1,6 @@
+from deeplearning4j_tpu.datasets.dataset import DataSet  # noqa: F401
+from deeplearning4j_tpu.datasets.iterator import (  # noqa: F401
+    BaseDatasetIterator,
+    DataSetIterator,
+    ListDataSetIterator,
+)

@@ -1,0 +1,196 @@
+"""DataSetIterator protocol + base implementations.
+
+Parity with ref: datasets/iterator/DataSetIterator.java:52 (hasNext/next/
+reset/batch/totalExamples/inputColumns/totalOutcomes) and
+BaseDatasetIterator / ListDataSetIterator / SamplingDataSetIterator /
+MultipleEpochsIterator (datasets/iterator/).
+
+Python-idiomatic: iterators are also iterable; the Java hasNext/next pair is
+kept for API parity with the reference call sites.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+class DataSetIterator:
+    """Abstract iterator over mini-batches (DataSet instances)."""
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next(self, num: Optional[int] = None) -> DataSet:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def batch(self) -> int:
+        raise NotImplementedError
+
+    def total_examples(self) -> int:
+        raise NotImplementedError
+
+    def input_columns(self) -> int:
+        raise NotImplementedError
+
+    def total_outcomes(self) -> int:
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[DataSet]:
+        self.reset()
+        while self.has_next():
+            yield self.next()
+
+
+class BaseDatasetIterator(DataSetIterator):
+    """Batched iteration over a fetcher (ref: BaseDatasetIterator.java)."""
+
+    def __init__(self, batch_size: int, num_examples: int, fetcher):
+        self._batch = batch_size
+        self._num_examples = num_examples if num_examples > 0 else fetcher.total_examples()
+        self.fetcher = fetcher
+
+    def has_next(self) -> bool:
+        return self.fetcher.has_more() and self.fetcher.cursor() < self._num_examples
+
+    def next(self, num: Optional[int] = None) -> DataSet:
+        n = num if num is not None else self._batch
+        n = min(n, self._num_examples - self.fetcher.cursor())
+        self.fetcher.fetch(n)
+        return self.fetcher.next()
+
+    def reset(self) -> None:
+        self.fetcher.reset()
+
+    def batch(self) -> int:
+        return self._batch
+
+    def total_examples(self) -> int:
+        return self._num_examples
+
+    def input_columns(self) -> int:
+        return self.fetcher.input_columns()
+
+    def total_outcomes(self) -> int:
+        return self.fetcher.total_outcomes()
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterate a pre-materialized list of examples (ref: ListDataSetIterator.java)."""
+
+    def __init__(self, data: "DataSet | Sequence[DataSet]", batch_size: int = 10):
+        if isinstance(data, DataSet):
+            self._data = data
+        else:
+            self._data = DataSet.merge(list(data))
+        self._batch = batch_size
+        self._cursor = 0
+
+    def has_next(self) -> bool:
+        return self._cursor < self._data.num_examples()
+
+    def next(self, num: Optional[int] = None) -> DataSet:
+        n = num if num is not None else self._batch
+        end = min(self._cursor + n, self._data.num_examples())
+        ds = DataSet(
+            self._data.features[self._cursor : end],
+            None if self._data.labels is None else self._data.labels[self._cursor : end],
+        )
+        self._cursor = end
+        return ds
+
+    def reset(self) -> None:
+        self._cursor = 0
+
+    def batch(self) -> int:
+        return self._batch
+
+    def total_examples(self) -> int:
+        return self._data.num_examples()
+
+    def input_columns(self) -> int:
+        return int(self._data.features.shape[-1])
+
+    def total_outcomes(self) -> int:
+        return 0 if self._data.labels is None else int(self._data.labels.shape[-1])
+
+
+class SamplingDataSetIterator(DataSetIterator):
+    """Sample batches with replacement (ref: SamplingDataSetIterator.java)."""
+
+    def __init__(self, sample_from: DataSet, batch_size: int, total_number_samples: int, seed: int = 0):
+        self._data = sample_from
+        self._batch = batch_size
+        self._total = total_number_samples
+        self._sampled = 0
+        self._rng = np.random.default_rng(seed)
+
+    def has_next(self) -> bool:
+        return self._sampled < self._total
+
+    def next(self, num: Optional[int] = None) -> DataSet:
+        n = num if num is not None else self._batch
+        idx = self._rng.integers(0, self._data.num_examples(), size=n)
+        self._sampled += n
+        return DataSet(
+            self._data.features[idx],
+            None if self._data.labels is None else self._data.labels[idx],
+        )
+
+    def reset(self) -> None:
+        self._sampled = 0
+
+    def batch(self) -> int:
+        return self._batch
+
+    def total_examples(self) -> int:
+        return self._total
+
+    def input_columns(self) -> int:
+        return int(self._data.features.shape[-1])
+
+    def total_outcomes(self) -> int:
+        return 0 if self._data.labels is None else int(self._data.labels.shape[-1])
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Repeat an underlying iterator N times (ref: MultipleEpochsIterator.java)."""
+
+    def __init__(self, num_epochs: int, underlying: DataSetIterator):
+        self.num_epochs = num_epochs
+        self.underlying = underlying
+        self._epoch = 0
+
+    def has_next(self) -> bool:
+        if self.underlying.has_next():
+            return True
+        if self._epoch + 1 < self.num_epochs:
+            self._epoch += 1
+            self.underlying.reset()
+            return self.underlying.has_next()
+        return False
+
+    def next(self, num: Optional[int] = None) -> DataSet:
+        return self.underlying.next(num)
+
+    def reset(self) -> None:
+        self._epoch = 0
+        self.underlying.reset()
+
+    def batch(self) -> int:
+        return self.underlying.batch()
+
+    def total_examples(self) -> int:
+        return self.underlying.total_examples() * self.num_epochs
+
+    def input_columns(self) -> int:
+        return self.underlying.input_columns()
+
+    def total_outcomes(self) -> int:
+        return self.underlying.total_outcomes()

@@ -1,0 +1,1 @@
+from deeplearning4j_tpu.eval.evaluation import ConfusionMatrix, Evaluation  # noqa: F401

@@ -1,0 +1,162 @@
+"""Pure functional network core.
+
+These are the functions ``jit``/``grad``/``pjit`` actually trace. The stateful
+``MultiLayerNetwork`` facade (multilayer.py) wraps them, mirroring how the
+reference's mutable MultiLayerNetwork sits over per-layer math
+(ref: nn/multilayer/MultiLayerNetwork.java:495-525 feedForward, :959-1010
+doBackWard). Backprop is jax.grad of the composed loss instead of the
+reference's hand-chained ``backwardGradient`` calls.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import layers as layer_ops
+from deeplearning4j_tpu.nn.api import LayerType
+from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.layers import output as output_layer
+from deeplearning4j_tpu.nn.layers.preprocessor import preprocessor
+from deeplearning4j_tpu.nn.params import init_layer_params
+from deeplearning4j_tpu.optimize.updater import apply_updater, init_updater_state
+
+Array = jax.Array
+NetParams = Tuple[dict, ...]
+
+
+def init_params(conf: MultiLayerConfiguration, key: Array) -> NetParams:
+    keys = jax.random.split(key, max(conf.n_layers, 1))
+    return tuple(
+        init_layer_params(keys[i], conf.conf(i)) for i in range(conf.n_layers)
+    )
+
+
+def _maybe_preprocess(conf: MultiLayerConfiguration, i: int, x: Array) -> Array:
+    name = conf.preprocessor_for(i)
+    return preprocessor(name)(x) if name else x
+
+
+def feed_forward(
+    conf: MultiLayerConfiguration,
+    params: NetParams,
+    x: Array,
+    *,
+    train: bool = False,
+    key: Optional[Array] = None,
+) -> List[Array]:
+    """Activations per layer, input first (ref: MultiLayerNetwork.java:495-525)."""
+    acts = [x]
+    keys = (
+        jax.random.split(key, conf.n_layers) if key is not None else [None] * conf.n_layers
+    )
+    for i in range(conf.n_layers):
+        x = _maybe_preprocess(conf, i, x)
+        x = layer_ops.forward(conf.conf(i), params[i], x, train=train, key=keys[i],
+                              drop_connect=conf.use_drop_connect)
+        acts.append(x)
+    return acts
+
+
+def output(conf: MultiLayerConfiguration, params: NetParams, x: Array) -> Array:
+    """Final network output (ref: MultiLayerNetwork.output :1184)."""
+    return feed_forward(conf, params, x)[-1]
+
+
+def hidden_activation(
+    conf: MultiLayerConfiguration, params: NetParams, x: Array, upto: int,
+    *, train: bool = False, key: Optional[Array] = None,
+) -> Array:
+    """Forward through layers [0, upto) — pretraining input for layer `upto`
+    (ref: MultiLayerNetwork.activationFromPrevLayer :479)."""
+    keys = jax.random.split(key, max(upto, 1)) if key is not None else [None] * max(upto, 1)
+    for i in range(upto):
+        x = _maybe_preprocess(conf, i, x)
+        x = layer_ops.forward(conf.conf(i), params[i], x, train=train, key=keys[i])
+    return x
+
+
+def network_loss(
+    conf: MultiLayerConfiguration,
+    params: NetParams,
+    x: Array,
+    labels: Array,
+    *,
+    train: bool = False,
+    key: Optional[Array] = None,
+) -> Array:
+    """Loss through the whole stack; the head uses the fused-logits path."""
+    n = conf.n_layers
+    keys = jax.random.split(key, n) if key is not None else [None] * n
+    for i in range(n - 1):
+        x = _maybe_preprocess(conf, i, x)
+        x = layer_ops.forward(conf.conf(i), params[i], x, train=train, key=keys[i],
+                              drop_connect=conf.use_drop_connect)
+    x = _maybe_preprocess(conf, n - 1, x)
+    head = conf.conf(n - 1)
+    if head.layer_type != LayerType.OUTPUT:
+        raise ValueError("network_loss requires an OUTPUT head layer")
+    return output_layer.output_loss(head, params[n - 1], x, labels, train=train,
+                                    key=keys[n - 1], drop_connect=conf.use_drop_connect)
+
+
+def make_train_step(conf: MultiLayerConfiguration, donate: bool = False,
+                    policy=None):
+    """Build the jitted full-network training step.
+
+    step(params, updater_states, iteration, x, labels, key)
+      -> (new_params, new_states, score)
+
+    One fused XLA program: forward, backward (jax.grad), per-layer updater —
+    the TPU equivalent of doBackWard's per-iteration body
+    (ref: MultiLayerNetwork.java:976-1002).
+
+    ``donate=True`` donates the params/state buffers to XLA (in-place update,
+    halves HBM traffic for the update) — only safe when the caller owns the
+    arrays exclusively, i.e. nothing else (facade fields, clones, listeners)
+    still references them. MultiLayerNetwork keeps False; the data-parallel
+    trainer and benches, which own their loop state, opt in.
+
+    ``policy`` (ops.dtypes.Policy) enables mixed precision: params/activations
+    are cast to ``policy.compute_dtype`` (e.g. bfloat16 for the MXU) inside
+    the step; master params, updater state, and the loss stay float32.
+    """
+
+    def step(params, states, iteration, x, labels, key):
+        kdrop, _ = jax.random.split(key)
+
+        def loss_fn(ps):
+            if policy is not None:
+                ps = jax.tree_util.tree_map(
+                    lambda a: a.astype(policy.compute_dtype), ps
+                )
+                xin = x.astype(policy.compute_dtype)
+            else:
+                xin = x
+            return network_loss(conf, ps, xin, labels, train=True, key=kdrop)
+
+        score, grads = jax.value_and_grad(loss_fn)(params)
+        new_params = []
+        new_states = []
+        for i in range(conf.n_layers):
+            upd, st = apply_updater(conf.conf(i), iteration, grads[i], params[i], states[i])
+            new_params.append(
+                jax.tree_util.tree_map(lambda p, u: p - u, params[i], upd)
+            )
+            new_states.append(st)
+        return tuple(new_params), tuple(new_states), score
+
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(step, donate_argnums=donate_argnums)
+
+
+def init_train_state(conf: MultiLayerConfiguration, params: NetParams):
+    return tuple(init_updater_state(params[i]) for i in range(conf.n_layers))
+
+
+def score(
+    conf: MultiLayerConfiguration, params: NetParams, x: Array, labels: Array
+) -> Array:
+    return network_loss(conf, params, x, labels, train=False)

@@ -1,0 +1,49 @@
+"""Gradient container.
+
+Parity with ref: nn/gradient/DefaultGradient.java — an ordered map of
+variable name → array. In JAX a gradient is just a pytree matching the params
+pytree, so this is a thin dict alias plus flattening helpers used by the
+flat-param-vector API (ref: MultiLayerNetwork.java:744-835 pack/unPack).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+# params for one layer: {"W": ..., "b": ...}; for a network: tuple of those
+LayerParams = Dict[str, Array]
+NetParams = Tuple[LayerParams, ...]
+
+
+def flatten_params(params) -> Array:
+    """Pack a params pytree into one flat vector (ref: params()/pack)."""
+    leaves = jax.tree_util.tree_leaves(params)
+    if not leaves:
+        return jnp.zeros((0,))
+    return jnp.concatenate([jnp.ravel(leaf) for leaf in leaves])
+
+
+def unflatten_params(template, flat: Array):
+    """Unpack a flat vector into the shape of `template` (ref: setParams/unPack)."""
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    expected = sum(leaf.size for leaf in leaves)
+    if flat.ndim != 1 or flat.shape[0] != expected:
+        raise ValueError(
+            f"Parameter vector of shape {flat.shape} does not match the "
+            f"network's {expected} parameters"
+        )
+    out: List[Array] = []
+    offset = 0
+    for leaf in leaves:
+        n = leaf.size
+        out.append(jnp.reshape(flat[offset : offset + n], leaf.shape).astype(leaf.dtype))
+        offset += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def num_params(params) -> int:
+    return sum(leaf.size for leaf in jax.tree_util.tree_leaves(params))

@@ -1,0 +1,55 @@
+"""Layer implementations as pure functions over (conf, params, input).
+
+Replaces the reference's stateful Layer classes + LayerFactory dispatch
+(ref: nn/layers/, nn/layers/factory/LayerFactories.java). ``forward`` is the
+single activate entry point; training uses jax.grad over composed forwards
+instead of the reference's hand-written backwardGradient chains.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+
+from deeplearning4j_tpu.nn.api import LayerType
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    autoencoder,
+    convolution,
+    dense,
+    lstm,
+    output,
+    rbm,
+    subsampling,
+)
+
+_FORWARD = {
+    LayerType.DENSE: dense.forward,
+    LayerType.OUTPUT: output.forward,
+    LayerType.RBM: rbm.forward,
+    LayerType.AUTOENCODER: autoencoder.forward,
+    LayerType.RECURSIVE_AUTOENCODER: autoencoder.forward,
+    LayerType.CONVOLUTION: convolution.forward,
+    LayerType.SUBSAMPLING: subsampling.forward,
+    LayerType.LSTM: lstm.forward,
+}
+
+
+_TAKES_DROP_CONNECT = {LayerType.DENSE, LayerType.OUTPUT}
+
+
+def forward(
+    conf: NeuralNetConfiguration,
+    params: Dict[str, jax.Array],
+    x: jax.Array,
+    *,
+    train: bool = False,
+    key: Optional[jax.Array] = None,
+    drop_connect: bool = False,
+) -> jax.Array:
+    """Layer.activate (ref: nn/api/Layer.java:37)."""
+    fn = _FORWARD[conf.layer_type]
+    if conf.layer_type in _TAKES_DROP_CONNECT:
+        return fn(conf, params, x, train=train, key=key, drop_connect=drop_connect)
+    return fn(conf, params, x, train=train, key=key)

@@ -1,0 +1,65 @@
+"""Denoising AutoEncoder.
+
+Parity with ref: nn/layers/feedforward/autoencoder/AutoEncoder.java:64-96 —
+encode = act(x·W + b), decode = act(h·Wᵀ + vb) (tied weights), corrupted input
+via binomial masking at conf.corruption_level. The pretrain objective is the
+configured loss (default RECONSTRUCTION_CROSSENTROPY) differentiated by
+jax.grad instead of the reference's hand-derived gradient.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.params import BIAS_KEY, VISIBLE_BIAS_KEY, WEIGHT_KEY
+from deeplearning4j_tpu.ops.activations import activation
+from deeplearning4j_tpu.ops.losses import loss
+
+
+def get_corrupted_input(key: jax.Array, x: jax.Array, corruption_level: float):
+    """Masking noise: zero each input element w.p. corruption_level
+    (ref: AutoEncoder.java getCorruptedInput)."""
+    keep = jax.random.bernoulli(key, 1.0 - corruption_level, x.shape)
+    return x * keep.astype(x.dtype)
+
+
+def encode(conf: NeuralNetConfiguration, params: Dict[str, jax.Array], x: jax.Array):
+    act = activation(conf.activation_function)
+    return act(x @ params[WEIGHT_KEY] + params[BIAS_KEY])
+
+
+def decode(conf: NeuralNetConfiguration, params: Dict[str, jax.Array], h: jax.Array):
+    act = activation(conf.activation_function)
+    return act(h @ params[WEIGHT_KEY].T + params[VISIBLE_BIAS_KEY])
+
+
+def forward(
+    conf: NeuralNetConfiguration,
+    params: Dict[str, jax.Array],
+    x: jax.Array,
+    *,
+    train: bool = False,
+    key: Optional[jax.Array] = None,
+) -> jax.Array:
+    return encode(conf, params, x)
+
+
+def pretrain_loss(
+    conf: NeuralNetConfiguration,
+    params: Dict[str, jax.Array],
+    x: jax.Array,
+    key: jax.Array,
+) -> jax.Array:
+    corrupted = get_corrupted_input(key, x, conf.corruption_level)
+    h = encode(conf, params, corrupted)
+    recon = decode(conf, params, h)
+    total = loss(conf.loss_function, x, recon)
+    if conf.apply_sparsity and conf.sparsity > 0:
+        # activation-sparsity penalty (ref: BasePretrainNetwork applySparsity;
+        # realized here as an L1 penalty on mean hidden activation)
+        total = total + conf.sparsity * jnp.mean(jnp.abs(h))
+    return total

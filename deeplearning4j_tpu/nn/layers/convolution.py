@@ -1,0 +1,42 @@
+"""Convolution layer.
+
+The reference loops ``Nd4j.getConvolution().convn(input, filter, VALID)`` per
+feature map (ref: nn/layers/convolution/ConvolutionLayer.java:115-128). Here a
+single batched ``lax.conv_general_dilated`` maps the whole layer onto the MXU
+(XLA lowers it to im2col+matmul or direct conv as it sees fit). Layout NCHW,
+filters OIHW, VALID padding to match the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.params import CONV_BIAS_KEY, CONV_WEIGHT_KEY
+from deeplearning4j_tpu.ops.activations import activation
+
+
+def forward(
+    conf: NeuralNetConfiguration,
+    params: Dict[str, jax.Array],
+    x: jax.Array,
+    *,
+    train: bool = False,
+    key: Optional[jax.Array] = None,
+) -> jax.Array:
+    w = params[CONV_WEIGHT_KEY]
+    b = params[CONV_BIAS_KEY]
+    out = lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=(1, 1),
+        padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.float32,
+    )
+    out = out + b[None, :, None, None]
+    return activation(conf.activation_function)(out)

@@ -1,0 +1,64 @@
+"""Dense (fully-connected) layer.
+
+Parity with the reference's BaseLayer: preOutput = x·W + b
+(ref: nn/layers/BaseLayer.java:272-281), activation via the registry
+(ref: BaseLayer.java:294), inverted-dropout masking during training
+(ref: BaseLayer.java:333 applyDropOutIfNecessary).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.params import BIAS_KEY, WEIGHT_KEY
+from deeplearning4j_tpu.ops.activations import activation
+
+
+_DROP_CONNECT_KEEP = 0.5  # ref BaseLayer drop-connect keeps weights w.p. 0.5
+
+
+def pre_output(
+    conf: NeuralNetConfiguration,
+    params: Dict[str, jax.Array],
+    x: jax.Array,
+    *,
+    train: bool = False,
+    key: Optional[jax.Array] = None,
+    drop_connect: bool = False,
+):
+    w = params[WEIGHT_KEY]
+    if drop_connect and train and key is not None:
+        # inverted drop-connect on the weight matrix (ref: BaseLayer.preOutput
+        # conf.isUseDropConnect branch)
+        mask = jax.random.bernoulli(key, _DROP_CONNECT_KEEP, w.shape)
+        w = jnp.where(mask, w / _DROP_CONNECT_KEEP, 0.0)
+    return x @ w + params[BIAS_KEY]
+
+
+def apply_dropout(x: jax.Array, rate: float, train: bool, key: Optional[jax.Array]):
+    if not train or rate <= 0.0 or key is None:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)
+
+
+def forward(
+    conf: NeuralNetConfiguration,
+    params: Dict[str, jax.Array],
+    x: jax.Array,
+    *,
+    train: bool = False,
+    key: Optional[jax.Array] = None,
+    drop_connect: bool = False,
+) -> jax.Array:
+    kdrop = kdc = None
+    if key is not None:
+        kdrop, kdc = jax.random.split(key)
+    x = apply_dropout(x, conf.dropout, train, kdrop)
+    pre = pre_output(conf, params, x, train=train, key=kdc, drop_connect=drop_connect)
+    return activation(conf.activation_function)(pre)

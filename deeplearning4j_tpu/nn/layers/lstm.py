@@ -1,0 +1,70 @@
+"""LSTM layer (Karpathy-style fused-gate char-LSTM).
+
+Parity with ref: nn/layers/recurrent/LSTM.java:54-160 — a single recurrent
+matrix maps [1 | x_t | h_{t-1}] to the fused i,f,o,g gate buffer ("iFog"),
+cell update c_t = f⊙c_{t-1} + i⊙g, h_t = o⊙tanh(c_t), then a decoder
+projection to the output.
+
+TPU-first: the reference's manual Java loop over time slices (and its
+hand-written BPTT at LSTM.java backward()) becomes one ``lax.scan`` whose
+gradient is derived by jax.grad — XLA unrolls/pipelines the scan and keeps the
+(batch, 4*hidden) gate matmuls on the MXU. Input layout: (batch, time, n_in).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.params import (
+    DECODER_BIAS_KEY,
+    DECODER_WEIGHT_KEY,
+    RECURRENT_WEIGHT_KEY,
+)
+
+Array = jax.Array
+
+
+def hidden_sequence(
+    conf: NeuralNetConfiguration, params: Dict[str, Array], x: Array
+) -> Array:
+    """Run the recurrence; returns h for every timestep: (batch, time, hidden)."""
+    if x.ndim == 2:  # single sequence (time, n_in) → add batch axis
+        x = x[None]
+    w = params[RECURRENT_WEIGHT_KEY]
+    batch = x.shape[0]
+    hidden = conf.n_out
+    ones = jnp.ones((batch, 1), x.dtype)
+
+    def step(carry, x_t):
+        h_prev, c_prev = carry
+        h_in = jnp.concatenate([ones, x_t, h_prev], axis=-1)
+        gates = h_in @ w
+        i = jax.nn.sigmoid(gates[:, :hidden])
+        f = jax.nn.sigmoid(gates[:, hidden : 2 * hidden])
+        o = jax.nn.sigmoid(gates[:, 2 * hidden : 3 * hidden])
+        g = jnp.tanh(gates[:, 3 * hidden :])
+        c = f * c_prev + i * g
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+    zeros = jnp.zeros((batch, hidden), x.dtype)
+    xs = jnp.swapaxes(x, 0, 1)  # (time, batch, n_in) for scan
+    _, hs = jax.lax.scan(step, (zeros, zeros), xs)
+    return jnp.swapaxes(hs, 0, 1)
+
+
+def forward(
+    conf: NeuralNetConfiguration,
+    params: Dict[str, Array],
+    x: Array,
+    *,
+    train: bool = False,
+    key: Optional[Array] = None,
+) -> Array:
+    """Decoded output per timestep (ref: LSTM.activate decoder projection)."""
+    hs = hidden_sequence(conf, params, x)
+    return hs @ params[DECODER_WEIGHT_KEY] + params[DECODER_BIAS_KEY]

@@ -1,0 +1,61 @@
+"""Output (classification/regression) layer.
+
+Parity with ref: nn/layers/OutputLayer.java — softmax/sigmoid head whose
+gradient is the label-error outer product (OutputLayer.java:98-117). Here the
+loss is differentiated by jax.grad; for the softmax+MCXENT / sigmoid+XENT
+pairs the fused log-softmax path is used so XLA folds it into the matmul.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers.dense import apply_dropout, pre_output
+from deeplearning4j_tpu.ops.activations import activation
+from deeplearning4j_tpu.ops.losses import FUSABLE, loss, loss_from_logits
+
+
+def forward(
+    conf: NeuralNetConfiguration,
+    params: Dict[str, jax.Array],
+    x: jax.Array,
+    *,
+    train: bool = False,
+    key: Optional[jax.Array] = None,
+    drop_connect: bool = False,
+) -> jax.Array:
+    kdrop = kdc = None
+    if key is not None:
+        kdrop, kdc = jax.random.split(key)
+    x = apply_dropout(x, conf.dropout, train, kdrop)
+    pre = pre_output(conf, params, x, train=train, key=kdc, drop_connect=drop_connect)
+    return activation(conf.activation_function)(pre)
+
+
+def output_loss(
+    conf: NeuralNetConfiguration,
+    params: Dict[str, jax.Array],
+    x: jax.Array,
+    labels: jax.Array,
+    *,
+    train: bool = False,
+    key: Optional[jax.Array] = None,
+    drop_connect: bool = False,
+) -> jax.Array:
+    """Scalar training loss for the head (ref: OutputLayer.score())."""
+    kdrop = kdc = None
+    if key is not None:
+        kdrop, kdc = jax.random.split(key)
+    x = apply_dropout(x, conf.dropout, train, kdrop)
+    logits = pre_output(conf, params, x, train=train, key=kdc, drop_connect=drop_connect)
+    # losses always accumulate in float32 even under a bf16 compute policy
+    logits = logits.astype(jnp.float32)
+    labels = labels.astype(jnp.float32)
+    if (conf.activation_function, conf.loss_function) in FUSABLE:
+        return loss_from_logits(conf.loss_function, labels, logits)
+    out = activation(conf.activation_function)(logits)
+    return loss(conf.loss_function, labels, out)

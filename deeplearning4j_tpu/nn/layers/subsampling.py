@@ -1,0 +1,42 @@
+"""Subsampling (pooling) layer.
+
+Parity with ref: nn/layers/convolution/subsampling/SubsamplingLayer.java:114-155
+— downsampling by conf.stride with MAX/SUM/AVG/NONE pooling
+(ConvolutionType, ref: ConvolutionLayer.ConvolutionType). Implemented with
+``lax.reduce_window`` so XLA fuses it; the reference's hand-written rot+FULL-conv
+backward is replaced by autodiff.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.api import ConvolutionType
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+
+
+def forward(
+    conf: NeuralNetConfiguration,
+    params: Dict[str, jax.Array],
+    x: jax.Array,
+    *,
+    train: bool = False,
+    key: Optional[jax.Array] = None,
+) -> jax.Array:
+    if conf.convolution_type == ConvolutionType.NONE:
+        return x
+    sh, sw = conf.stride[-2], conf.stride[-1]
+    window = (1, 1, sh, sw)
+    strides = (1, 1, sh, sw)
+    if conf.convolution_type == ConvolutionType.MAX:
+        return lax.reduce_window(x, -jnp.inf, lax.max, window, strides, "VALID")
+    summed = lax.reduce_window(x, 0.0, lax.add, window, strides, "VALID")
+    if conf.convolution_type == ConvolutionType.SUM:
+        return summed
+    if conf.convolution_type == ConvolutionType.AVG:
+        return summed / float(sh * sw)
+    raise ValueError(f"Unhandled pooling type {conf.convolution_type}")

@@ -1,0 +1,86 @@
+"""Named-parameter initialization per layer type.
+
+Parity with ref: nn/params/ — DefaultParamInitializer (W, b),
+PretrainParamInitializer (+vb), ConvolutionParamInitializer
+(convweights, convbias), LSTMParamInitializer (recurrentweights,
+decoderweights, decoderbias). Same parameter keys so flat param vectors and
+checkpoints line up with the reference's ordering conventions.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.api import LayerType
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.weights import init_weights
+
+# canonical parameter keys (ref: nn/params/*.java)
+WEIGHT_KEY = "W"
+BIAS_KEY = "b"
+VISIBLE_BIAS_KEY = "vb"
+CONV_WEIGHT_KEY = "convweights"
+CONV_BIAS_KEY = "convbias"
+RECURRENT_WEIGHT_KEY = "recurrentweights"
+DECODER_WEIGHT_KEY = "decoderweights"
+DECODER_BIAS_KEY = "decoderbias"
+
+
+def _dense_params(key, conf: NeuralNetConfiguration) -> Dict[str, jax.Array]:
+    wkey, _ = jax.random.split(key)
+    return {
+        WEIGHT_KEY: init_weights(wkey, (conf.n_in, conf.n_out), conf.weight_init, conf.dist),
+        BIAS_KEY: jnp.zeros((conf.n_out,)),
+    }
+
+
+def _pretrain_params(key, conf: NeuralNetConfiguration) -> Dict[str, jax.Array]:
+    p = _dense_params(key, conf)
+    p[VISIBLE_BIAS_KEY] = jnp.zeros((conf.n_in,))
+    return p
+
+
+def _conv_params(key, conf: NeuralNetConfiguration) -> Dict[str, jax.Array]:
+    # OIHW filters: (out_channels, in_channels, kh, kw). The reference stores
+    # per-feature-map filters of shape filterSize and loops convn over maps
+    # (ref: ConvolutionLayer.java:115-128); one batched lax.conv here.
+    kh, kw = conf.filter_size[-2], conf.filter_size[-1]
+    shape = (conf.n_out, conf.n_in, kh, kw)
+    wkey, _ = jax.random.split(key)
+    return {
+        CONV_WEIGHT_KEY: init_weights(wkey, shape, conf.weight_init, conf.dist),
+        CONV_BIAS_KEY: jnp.zeros((conf.n_out,)),
+    }
+
+
+def _lstm_params(key, conf: NeuralNetConfiguration) -> Dict[str, jax.Array]:
+    # Karpathy-style fused-gate LSTM (ref: nn/layers/recurrent/LSTM.java:54-160,
+    # nn/params/LSTMParamInitializer.java:39-41): one recurrent matrix maps
+    # [1, x_t, h_{t-1}] -> 4*hidden (i,f,o,g fused), plus a decoder to n_out.
+    hidden = conf.n_out
+    in_dim = 1 + conf.n_in + hidden
+    k1, k2, _ = jax.random.split(key, 3)
+    return {
+        RECURRENT_WEIGHT_KEY: init_weights(k1, (in_dim, 4 * hidden), conf.weight_init, conf.dist),
+        DECODER_WEIGHT_KEY: init_weights(k2, (hidden, conf.n_out), conf.weight_init, conf.dist),
+        DECODER_BIAS_KEY: jnp.zeros((conf.n_out,)),
+    }
+
+
+def init_layer_params(key: jax.Array, conf: NeuralNetConfiguration) -> Dict[str, jax.Array]:
+    """conf → named params; dispatch replaces ref LayerFactories.getFactory."""
+    t = conf.layer_type
+    if t in (LayerType.DENSE, LayerType.OUTPUT):
+        return _dense_params(key, conf)
+    if t in (LayerType.RBM, LayerType.AUTOENCODER, LayerType.RECURSIVE_AUTOENCODER):
+        return _pretrain_params(key, conf)
+    if t == LayerType.CONVOLUTION:
+        return _conv_params(key, conf)
+    if t == LayerType.SUBSAMPLING:
+        return {}  # pooling has no params (ref: SubsampleParamInitializer)
+    if t == LayerType.LSTM:
+        return _lstm_params(key, conf)
+    raise ValueError(f"No param initializer for layer type {t}")

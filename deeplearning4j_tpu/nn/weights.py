@@ -1,0 +1,80 @@
+"""Weight initialization schemes.
+
+Parity with the reference's ``WeightInit`` enum and ``WeightInitUtil`` switch
+(ref: nn/weights/WeightInit.java:25-38, nn/weights/WeightInitUtil.java:78-100):
+
+- NORMALIZED: U(0,1) - 0.5, divided by fan-in
+- UNIFORM:    U(-1/fanIn, 1/fanIn)
+- VI:         U(-r, r) with r = sqrt(6)/sqrt(sum(shape)+1)
+- SIZE:       U(-s, s) with s = sqrt(6/(fanIn+fanOut))
+- DISTRIBUTION: sample from a configured distribution
+- ZERO:       zeros
+"""
+
+from __future__ import annotations
+
+import enum
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class WeightInit(str, enum.Enum):
+    DISTRIBUTION = "DISTRIBUTION"
+    NORMALIZED = "NORMALIZED"
+    SIZE = "SIZE"
+    UNIFORM = "UNIFORM"
+    VI = "VI"
+    ZERO = "ZERO"
+
+    @classmethod
+    def coerce(cls, v) -> "WeightInit":
+        return v if isinstance(v, cls) else cls(str(v).upper())
+
+
+# A configured distribution is ("normal", mean, std) or ("uniform", lo, hi) —
+# the serializable analogue of the reference's nn/conf/distribution classes.
+Distribution = Tuple[str, float, float]
+
+
+def sample_distribution(key: jax.Array, dist: Distribution, shape: Sequence[int]):
+    kind, a, b = dist
+    if kind == "normal":
+        return a + b * jax.random.normal(key, shape)
+    if kind == "uniform":
+        return jax.random.uniform(key, shape, minval=a, maxval=b)
+    raise ValueError(f"Unknown distribution kind '{kind}'")
+
+
+def init_weights(
+    key: jax.Array,
+    shape: Sequence[int],
+    scheme: "WeightInit | str",
+    dist: Optional[Distribution] = None,
+    dtype=jnp.float32,
+) -> jax.Array:
+    scheme = WeightInit.coerce(scheme)
+    shape = tuple(int(s) for s in shape)
+    fan_in = shape[0]
+    if scheme == WeightInit.ZERO:
+        return jnp.zeros(shape, dtype)
+    if scheme == WeightInit.NORMALIZED:
+        u = jax.random.uniform(key, shape, dtype)
+        return (u - 0.5) / fan_in
+    if scheme == WeightInit.UNIFORM:
+        a = 1.0 / fan_in
+        return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+    if scheme == WeightInit.VI:
+        r = math.sqrt(6.0) / math.sqrt(sum(shape) + 1.0)
+        return jax.random.uniform(key, shape, dtype, minval=-r, maxval=r)
+    if scheme == WeightInit.SIZE:
+        fan_out = shape[1] if len(shape) > 1 else shape[0]
+        s = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, minval=-s, maxval=s)
+    if scheme == WeightInit.DISTRIBUTION:
+        if dist is None:
+            dist = ("normal", 0.0, 0.01)
+        return sample_distribution(key, dist, shape).astype(dtype)
+    raise ValueError(f"Unhandled weight init {scheme}")

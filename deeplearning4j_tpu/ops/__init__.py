@@ -1,0 +1,10 @@
+"""Tensor-op substrate — the TPU-native analogue of the ND4J facade.
+
+Every compute-heavy op in the reference goes through ``Nd4j.getExecutioner()``
+/ ``Nd4j.getBlasWrapper()`` (ref: nn/layers/BaseLayer.java:294). Here the
+substrate is jax.numpy + lax, with named registries for activations and losses
+mirroring the string-keyed transform-op registry the reference uses.
+"""
+
+from deeplearning4j_tpu.ops.activations import activation, activation_names  # noqa: F401
+from deeplearning4j_tpu.ops.losses import LossFunction, loss  # noqa: F401

@@ -1,0 +1,124 @@
+"""Activation registry.
+
+Parity with the reference's string-keyed transform-op dispatch
+(``Nd4j.getExecutioner().execAndReturn(Nd4j.getOpFactory()
+.createTransform(conf.getActivationFunction(), ...))``,
+ref: nn/layers/BaseLayer.java:294). Activations are named by the same strings
+the reference configs use ("sigmoid", "tanh", "relu", "softmax", ...), so JSON
+configs round-trip.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_REGISTRY: Dict[str, Callable[[Array], Array]] = {}
+
+
+def register(name: str):
+    def deco(fn: Callable[[Array], Array]) -> Callable[[Array], Array]:
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+@register("sigmoid")
+def sigmoid(x: Array) -> Array:
+    return jax.nn.sigmoid(x)
+
+
+@register("tanh")
+def tanh(x: Array) -> Array:
+    return jnp.tanh(x)
+
+
+@register("relu")
+def relu(x: Array) -> Array:
+    return jax.nn.relu(x)
+
+
+@register("leakyrelu")
+def leakyrelu(x: Array) -> Array:
+    return jax.nn.leaky_relu(x, negative_slope=0.01)
+
+
+@register("hardtanh")
+def hardtanh(x: Array) -> Array:
+    return jnp.clip(x, -1.0, 1.0)
+
+
+@register("softplus")
+def softplus(x: Array) -> Array:
+    return jax.nn.softplus(x)
+
+
+@register("softsign")
+def softsign(x: Array) -> Array:
+    return jax.nn.soft_sign(x)
+
+
+@register("linear")
+@register("identity")
+def identity(x: Array) -> Array:
+    return x
+
+
+@register("exp")
+def exp(x: Array) -> Array:
+    return jnp.exp(x)
+
+
+@register("softmax")
+def softmax(x: Array) -> Array:
+    # Row-wise softmax over the feature axis, matching the reference's
+    # per-example softmax on 2D (batch, features) activations.
+    return jax.nn.softmax(x, axis=-1)
+
+
+@register("cube")
+def cube(x: Array) -> Array:
+    return x * x * x
+
+
+def activation(name: str) -> Callable[[Array], Array]:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"Unknown activation '{name}'. Known: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def activation_names() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def derivative(name: str, activated: Array) -> Array:
+    """Derivative expressed in terms of the *activated* output.
+
+    The reference dispatches "<name>" derivative transform ops on already-
+    activated values (e.g. sigmoid' = y*(1-y)). Kept for parity in places that
+    need explicit error signals; the training path itself uses jax.grad.
+    """
+    if name == "sigmoid":
+        return activated * (1.0 - activated)
+    if name == "tanh":
+        return 1.0 - activated**2
+    if name == "relu":
+        return (activated > 0).astype(activated.dtype)
+    if name in ("linear", "identity"):
+        return jnp.ones_like(activated)
+    if name == "softmax":
+        # elementwise diagonal approximation, as the reference uses
+        return activated * (1.0 - activated)
+    if name == "hardtanh":
+        return ((activated > -1.0) & (activated < 1.0)).astype(activated.dtype)
+    if name == "softplus":
+        return jax.nn.sigmoid(activated)
+    raise ValueError(f"No derivative registered for activation '{name}'")

@@ -1,0 +1,31 @@
+"""Dtype policy for TPU execution.
+
+Params are kept in float32 (master weights); compute may run in bfloat16 on
+the MXU. The reference has no dtype policy (ND4J floats throughout); bfloat16
+is the TPU-idiomatic addition.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.float32
+    output_dtype: jnp.dtype = jnp.float32
+
+
+DEFAULT = Policy()
+BF16_COMPUTE = Policy(compute_dtype=jnp.bfloat16)
+
+
+def cast_in(policy: Policy, x):
+    return x.astype(policy.compute_dtype)
+
+
+def cast_out(policy: Policy, x):
+    return x.astype(policy.output_dtype)

@@ -1,0 +1,90 @@
+"""Loss functions — parity with ND4J's LossFunctions enum.
+
+The reference delegates loss computation to the external ND4J
+``LossFunctions.LossFunction`` enum (used at ref: nn/layers/BaseLayer.java:134-146,
+nn/layers/OutputLayer.java:77). The same names are accepted here (as strings or
+enum members) so JSON configs round-trip.
+
+All losses are mean-per-example scalars, implemented with numerically stable
+jnp primitives so XLA can fuse them into the backward matmuls.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_EPS = 1e-7
+
+
+class LossFunction(str, enum.Enum):
+    MSE = "MSE"
+    EXPLL = "EXPLL"
+    XENT = "XENT"
+    MCXENT = "MCXENT"
+    RMSE_XENT = "RMSE_XENT"
+    SQUARED_LOSS = "SQUARED_LOSS"
+    RECONSTRUCTION_CROSSENTROPY = "RECONSTRUCTION_CROSSENTROPY"
+    NEGATIVELOGLIKELIHOOD = "NEGATIVELOGLIKELIHOOD"
+
+    @classmethod
+    def coerce(cls, v: "LossFunction | str") -> "LossFunction":
+        if isinstance(v, LossFunction):
+            return v
+        return cls(str(v))
+
+
+def _clip(p: Array) -> Array:
+    return jnp.clip(p, _EPS, 1.0 - _EPS)
+
+
+def loss(kind: "LossFunction | str", labels: Array, output: Array) -> Array:
+    """Scalar loss. `output` is the network's activated output."""
+    kind = LossFunction.coerce(kind)
+    n = labels.shape[0]
+    if kind == LossFunction.MSE:
+        return jnp.mean(jnp.sum((labels - output) ** 2, axis=-1) / 2.0)
+    if kind == LossFunction.SQUARED_LOSS:
+        return jnp.sum((labels - output) ** 2) / n
+    if kind == LossFunction.RMSE_XENT:
+        xent = -(labels * jnp.log(_clip(output)))
+        return jnp.sqrt(jnp.mean(jnp.sum(xent, axis=-1)) + _EPS)
+    if kind in (LossFunction.XENT, LossFunction.RECONSTRUCTION_CROSSENTROPY):
+        p = _clip(output)
+        return -jnp.mean(
+            jnp.sum(labels * jnp.log(p) + (1.0 - labels) * jnp.log(1.0 - p), axis=-1)
+        )
+    if kind in (LossFunction.MCXENT, LossFunction.NEGATIVELOGLIKELIHOOD):
+        return -jnp.mean(jnp.sum(labels * jnp.log(_clip(output)), axis=-1))
+    if kind == LossFunction.EXPLL:
+        return jnp.mean(jnp.sum(output - labels * jnp.log(_clip(output)), axis=-1))
+    raise ValueError(f"Unhandled loss function {kind}")
+
+
+def loss_from_logits(kind: "LossFunction | str", labels: Array, logits: Array) -> Array:
+    """Stable fused softmax/sigmoid + cross-entropy path for the hot losses.
+
+    XLA fuses log_softmax into the preceding matmul; used by OutputLayer when
+    the activation/loss pair allows it (softmax+MCXENT, sigmoid+XENT).
+    """
+    kind = LossFunction.coerce(kind)
+    if kind in (LossFunction.MCXENT, LossFunction.NEGATIVELOGLIKELIHOOD):
+        return -jnp.mean(jnp.sum(labels * jax.nn.log_softmax(logits, axis=-1), axis=-1))
+    if kind in (LossFunction.XENT, LossFunction.RECONSTRUCTION_CROSSENTROPY):
+        # sigmoid cross entropy on logits: max(x,0) - x*z + log(1+exp(-|x|))
+        x, z = logits, labels
+        per = jnp.maximum(x, 0) - x * z + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        return jnp.mean(jnp.sum(per, axis=-1))
+    raise ValueError(f"No fused-logits path for {kind}")
+
+
+FUSABLE = {
+    ("softmax", LossFunction.MCXENT),
+    ("softmax", LossFunction.NEGATIVELOGLIKELIHOOD),
+    ("sigmoid", LossFunction.XENT),
+    ("sigmoid", LossFunction.RECONSTRUCTION_CROSSENTROPY),
+}

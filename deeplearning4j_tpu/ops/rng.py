@@ -1,0 +1,27 @@
+"""Stateless RNG discipline.
+
+The reference threads a single mutable ``org.apache.commons.math3.random``
+RNG through every layer (conf field ``rng``, ref:
+nn/conf/NeuralNetConfiguration.java:85). Under XLA everything must be
+functional: a root PRNG key is split per use. ``KeySequence`` is a small
+host-side convenience that hands out fresh keys for the stateful facade
+(MultiLayerNetwork); inside jitted code keys are threaded explicitly.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class KeySequence:
+    """Host-side key dispenser (NOT for use inside jit)."""
+
+    def __init__(self, seed: int = 123):
+        self._key = jax.random.PRNGKey(seed)
+
+    def next(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def fold(self, data: int) -> jax.Array:
+        return jax.random.fold_in(self._key, data)

@@ -1,0 +1,2 @@
+from deeplearning4j_tpu.optimize.updater import UpdaterState, init_updater_state, apply_updater  # noqa: F401
+from deeplearning4j_tpu.optimize.solver import Solver  # noqa: F401

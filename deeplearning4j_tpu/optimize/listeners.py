@@ -1,0 +1,46 @@
+"""Iteration listeners.
+
+Parity with ref: optimize/api/IterationListener.java + optimize/listeners/
+(ScoreIterationListener, ComposableIterationListener). Called from the host
+side of the solver loop with the iteration index and current score.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Iterable, List
+
+log = logging.getLogger(__name__)
+
+# listener(model, iteration, score)
+IterationListener = Callable[[object, int, float], None]
+
+
+class ScoreIterationListener:
+    """Log the score every N iterations (ref: ScoreIterationListener.java)."""
+
+    def __init__(self, print_iterations: int = 10):
+        self.print_iterations = max(1, print_iterations)
+
+    def __call__(self, model, iteration: int, score: float) -> None:
+        if iteration % self.print_iterations == 0:
+            log.info("Score at iteration %d is %s", iteration, score)
+
+
+class ComposableIterationListener:
+    def __init__(self, listeners: Iterable[IterationListener]):
+        self._listeners: List[IterationListener] = list(listeners)
+
+    def __call__(self, model, iteration: int, score: float) -> None:
+        for listener in self._listeners:
+            listener(model, iteration, score)
+
+
+class CollectScoresListener:
+    """Test/bench helper: records (iteration, score) pairs."""
+
+    def __init__(self):
+        self.scores: List[tuple] = []
+
+    def __call__(self, model, iteration: int, score: float) -> None:
+        self.scores.append((iteration, score))

@@ -1,0 +1,266 @@
+"""Solver — optimization driver.
+
+Parity with ref: optimize/Solver.java:57-73 (dispatch on
+OptimizationAlgorithm), optimize/solvers/BaseOptimizer.java:129-206 (the
+iterate → adjust-gradient → line-search → terminate loop),
+BackTrackLineSearch.java, ConjugateGradient.java, LBFGS.java,
+IterationGradientDescent.java.
+
+TPU-first design:
+- one jitted ``value_and_grad`` per solver instance; the backtracking line
+  search runs entirely on device as a ``lax.while_loop`` (the reference's line
+  search re-enters the whole Java forward pass per trial step);
+- the outer numIterations loop stays on the host so IterationListeners and
+  termination checks keep reference semantics;
+- HESSIAN_FREE falls back to CG (the reference's StochasticHessianFree is a
+  CG-on-Gauss-Newton scheme; divergence documented).
+
+Parameters travel as pytrees; line-search solvers flatten to one vector
+(ref: MultiLayerNetwork params()/setParams round-trip).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.api import OptimizationAlgorithm
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.gradient import flatten_params, unflatten_params
+from deeplearning4j_tpu.optimize.terminations import EpsTermination, ZeroDirection
+from deeplearning4j_tpu.optimize.updater import apply_updater, init_updater_state
+
+Array = jax.Array
+
+
+def backtrack_line_search(
+    f: Callable[[Array], Array],
+    x: Array,
+    fx: Array,
+    g: Array,
+    direction: Array,
+    max_iterations: int,
+    initial_step: float = 1.0,
+    c1: float = 1e-4,
+    rho: float = 0.5,
+):
+    """Armijo backtracking on device (ref: BackTrackLineSearch.java).
+
+    Returns the accepted step size (0.0 if no decrease found).
+    """
+    slope = jnp.vdot(g, direction)
+
+    def cond(state):
+        step, it, done = state
+        return (~done) & (it < max_iterations)
+
+    def body(state):
+        step, it, _ = state
+        ok = f(x + step * direction) <= fx + c1 * step * slope
+        return jax.lax.cond(
+            ok,
+            lambda: (step, it + 1, True),
+            lambda: (step * rho, it + 1, False),
+        )
+
+    step, _, done = jax.lax.while_loop(
+        cond, body, (jnp.asarray(initial_step, jnp.float32), 0, False)
+    )
+    return jnp.where(done, step, 0.0)
+
+
+class Solver:
+    """Optimizes ``score_fn`` starting from a params pytree.
+
+    score_fn(params, key) -> scalar (minimized); the per-iteration key lets
+    stochastic objectives (e.g. denoising-AE corruption masks) resample fresh
+    noise each iteration.
+    grad_fn(params, key) -> params-shaped gradient pytree; defaults to
+    jax.grad of score_fn. RBM pretraining passes its CD-k estimator here,
+    mirroring Model.gradientAndScore() dispatch (ref: BaseOptimizer.java:133).
+    """
+
+    def __init__(
+        self,
+        conf: NeuralNetConfiguration,
+        score_fn: Callable,
+        grad_fn: Optional[Callable] = None,
+        listeners: Sequence[Callable] = (),
+        num_iterations: Optional[int] = None,
+    ):
+        self.conf = conf
+        self.listeners = list(listeners)
+        self.num_iterations = num_iterations if num_iterations is not None else conf.num_iterations
+        self._score = jax.jit(score_fn)
+        if grad_fn is None:
+            vg = jax.jit(jax.value_and_grad(score_fn))
+            self._value_and_grad = vg
+        else:
+            g = jax.jit(grad_fn)
+
+            def grad_fn_custom(params, key):
+                return self._score(params, key), g(params, key)
+
+            self._value_and_grad = grad_fn_custom
+        self._terminations = [EpsTermination(), ZeroDirection()]
+        self.score_history: List[float] = []
+
+    # ---- public API (ref: Solver.optimize) ----
+    def optimize(self, params, key: Optional[Array] = None,
+                 algo: Optional[OptimizationAlgorithm] = None):
+        """Run the configured algorithm; ``algo`` overrides the conf's choice
+        (used e.g. to force iteration GD for CD-k pretraining, whose gradient
+        does not come from the score surface)."""
+        algo = algo or self.conf.optimization_algo
+        if key is None:
+            key = jax.random.PRNGKey(self.conf.seed)
+        if algo in (
+            OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT,
+            OptimizationAlgorithm.GRADIENT_DESCENT,
+        ):
+            return self._iteration_gd(params, key)
+        if algo in (
+            OptimizationAlgorithm.CONJUGATE_GRADIENT,
+            OptimizationAlgorithm.HESSIAN_FREE,
+        ):
+            return self._conjugate_gradient(params, key)
+        if algo == OptimizationAlgorithm.LBFGS:
+            return self._lbfgs(params, key)
+        raise ValueError(f"Unhandled optimization algorithm {algo}")
+
+    # ---- shared helpers ----
+    def _notify(self, iteration: int, score: float):
+        self.score_history.append(score)
+        for listener in self.listeners:
+            listener(self, iteration, score)
+
+    def _should_stop(self, score: float, old_score: float, grad_norm: float) -> bool:
+        return any(t.terminate(score, old_score, grad_norm) for t in self._terminations)
+
+    def _make_line_search(self, template):
+        """Jitted Armijo search over the flat param vector; the key is an
+        argument so stochastic objectives stay consistent within one search."""
+
+        def ls(x, fx, g, d, key):
+            def f(flat):
+                return self._score(unflatten_params(template, flat), key)
+
+            return backtrack_line_search(
+                f, x, fx, g, d, max_iterations=self.conf.num_line_search_iterations
+            )
+
+        return jax.jit(ls)
+
+    # ---- iteration gradient descent (SGD + updater) ----
+    def _iteration_gd(self, params, key):
+        state = init_updater_state(params)
+
+        @jax.jit
+        def step(params, state, iteration, key):
+            score, grads = self._value_and_grad(params, key)
+            update, state = apply_updater(self.conf, iteration, grads, params, state)
+            new_params = jax.tree_util.tree_map(lambda p, u: p - u, params, update)
+            return new_params, state, score
+
+        old_score = float("inf")
+        for i in range(self.num_iterations):
+            key, sub = jax.random.split(key)
+            params, state, score = step(params, state, jnp.asarray(i), sub)
+            score = float(score)
+            self._notify(i, score)
+            if self._should_stop(score, old_score, float("inf")):
+                break
+            old_score = score
+        return params
+
+    # ---- conjugate gradient with backtracking line search ----
+    def _conjugate_gradient(self, params, key):
+        template = params
+        ls = self._make_line_search(template)
+        x = flatten_params(params)
+        old_score = float("inf")
+        g_prev = None
+        d = None
+        for i in range(self.num_iterations):
+            key, sub = jax.random.split(key)
+            score, grads = self._value_and_grad(unflatten_params(template, x), sub)
+            g = flatten_params(grads)
+            score = float(score)
+            gnorm = float(jnp.linalg.norm(g))
+            self._notify(i, score)
+            if self._should_stop(score, old_score, gnorm):
+                break
+            if d is None:
+                d = -g
+            else:
+                # Polak-Ribière with automatic restart (ref: ConjugateGradient.java)
+                beta = float(jnp.vdot(g, g - g_prev) / (jnp.vdot(g_prev, g_prev) + 1e-12))
+                beta = max(0.0, beta)
+                d = -g + beta * d
+                if float(jnp.vdot(d, g)) >= 0:  # not a descent direction → restart
+                    d = -g
+            step = ls(x, jnp.asarray(score), g, d, sub)
+            if float(step) == 0.0:
+                d = -g
+                step = ls(x, jnp.asarray(score), g, d, sub)
+                if float(step) == 0.0:
+                    break
+            x = x + step * d
+            g_prev = g
+            old_score = score
+        return unflatten_params(template, x)
+
+    # ---- L-BFGS (two-loop recursion, history m=5; ref: LBFGS.java) ----
+    def _lbfgs(self, params, key, history: int = 5):
+        template = params
+        ls = self._make_line_search(template)
+        x = flatten_params(params)
+        s_hist: List[Array] = []
+        y_hist: List[Array] = []
+        old_score = float("inf")
+        g_prev = None
+        x_prev = None
+        for i in range(self.num_iterations):
+            key, sub = jax.random.split(key)
+            score, grads = self._value_and_grad(unflatten_params(template, x), sub)
+            g = flatten_params(grads)
+            score = float(score)
+            gnorm = float(jnp.linalg.norm(g))
+            self._notify(i, score)
+            if self._should_stop(score, old_score, gnorm):
+                break
+            if g_prev is not None:
+                s, y = x - x_prev, g - g_prev
+                if float(jnp.vdot(s, y)) > 1e-10:
+                    s_hist.append(s)
+                    y_hist.append(y)
+                    if len(s_hist) > history:
+                        s_hist.pop(0)
+                        y_hist.pop(0)
+            # two-loop recursion
+            q = g
+            alphas = []
+            for s, y in zip(reversed(s_hist), reversed(y_hist)):
+                rho_i = 1.0 / float(jnp.vdot(y, s))
+                a = rho_i * float(jnp.vdot(s, q))
+                alphas.append((a, rho_i))
+                q = q - a * y
+            if s_hist:
+                gamma = float(jnp.vdot(s_hist[-1], y_hist[-1]) / jnp.vdot(y_hist[-1], y_hist[-1]))
+                q = gamma * q
+            for (a, rho_i), s, y in zip(reversed(alphas), s_hist, y_hist):
+                b = rho_i * float(jnp.vdot(y, q))
+                q = q + (a - b) * s
+            d = -q
+            step = ls(x, jnp.asarray(score), g, d, sub)
+            if float(step) == 0.0:
+                d = -g
+                step = ls(x, jnp.asarray(score), g, d, sub)
+                if float(step) == 0.0:
+                    break
+            x_prev, g_prev = x, g
+            x = x + step * d
+            old_score = score
+        return unflatten_params(template, x)

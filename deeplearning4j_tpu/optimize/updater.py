@@ -1,0 +1,101 @@
+"""Gradient updater — parity with ref: optimize/GradientAdjustment.java:52-125.
+
+Reference update order per variable:
+  1. AdaGrad scaling (ND4J AdaGrad: g * lr / (sqrt(Σg²) + eps)) if useAdaGrad,
+     else g *= lr; adagrad history optionally reset every
+     resetAdaGradIterations (GradientAdjustment.java:78-83)
+  2. momentum (with momentumAfter schedule, :85-92)
+  3. L2 weight decay (:108) or L1 (:110)
+  4. optional unit-norm constraint (:116)
+  5. ÷ batchSize (:120)
+
+Deliberate divergences from the reference (behavioral bug fixes, flagged per
+SURVEY.md §7 "hard parts (b)"):
+- the reference's momentum line ``g += g*m + g*(1-m)`` degenerates to ``g *= 2``
+  for any momentum value; implemented here as standard heavy-ball velocity.
+- the reference's L1 branch triggers on ``l1 < 0`` (sign bug) and overwrites the
+  gradient; implemented here as standard L1 subgradient decay for ``l1 > 0``.
+- no final ÷batchSize: reference gradients are per-batch *sums*; ours are
+  already per-example means (losses are means), so the division is built in.
+
+State is a pytree parallel to params: {"hist": Σg², "v": velocity} — pure data,
+carried through jit like any other pytree.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+
+Array = jax.Array
+UpdaterState = Dict[str, Any]
+
+_ADAGRAD_EPS = 1e-6
+
+
+def init_updater_state(params) -> UpdaterState:
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    return {"hist": zeros, "v": jax.tree_util.tree_map(jnp.zeros_like, params)}
+
+
+def _momentum_at(conf: NeuralNetConfiguration, iteration: Array) -> Array:
+    """Momentum under the momentumAfter schedule; traced-iteration safe."""
+    m = jnp.asarray(conf.momentum, jnp.float32)
+    for it, val in conf.momentum_after:
+        m = jnp.where(iteration >= it, val, m)
+    return m
+
+
+def apply_updater(
+    conf: NeuralNetConfiguration,
+    iteration: Array,
+    grads,
+    params,
+    state: UpdaterState,
+) -> Tuple[Any, UpdaterState]:
+    """Returns (updates, new_state); caller applies ``params - updates``."""
+    hist, vel = state["hist"], state["v"]
+
+    if conf.reset_ada_grad_iterations > 0:
+        reset = (iteration > 0) & (iteration % conf.reset_ada_grad_iterations == 0)
+        hist = jax.tree_util.tree_map(
+            lambda h: jnp.where(reset, jnp.zeros_like(h), h), hist
+        )
+
+    if conf.use_ada_grad:
+        new_hist = jax.tree_util.tree_map(lambda h, g: h + g * g, hist, grads)
+        scaled = jax.tree_util.tree_map(
+            lambda g, h2: g * conf.lr / (jnp.sqrt(h2) + _ADAGRAD_EPS), grads, new_hist
+        )
+    else:
+        new_hist = hist
+        scaled = jax.tree_util.tree_map(lambda g: g * conf.lr, grads)
+
+    m = _momentum_at(conf, iteration)
+    if conf.momentum > 0 or conf.momentum_after:
+        new_vel = jax.tree_util.tree_map(lambda v, u: m * v + u, vel, scaled)
+        update = new_vel
+    else:
+        new_vel = vel
+        update = scaled
+
+    if conf.use_regularization and conf.l2 > 0:
+        update = jax.tree_util.tree_map(
+            lambda u, p: u + p * (conf.l2 * conf.lr), update, params
+        )
+    if conf.use_regularization and conf.l1 > 0:
+        update = jax.tree_util.tree_map(
+            lambda u, p: u + jnp.sign(p) * conf.l1, update, params
+        )
+
+    if conf.constrain_gradient_to_unit_norm:
+        norm = jnp.sqrt(
+            sum(jnp.sum(u * u) for u in jax.tree_util.tree_leaves(update))
+        )
+        update = jax.tree_util.tree_map(lambda u: u / (norm + 1e-12), update)
+
+    return update, {"hist": new_hist, "v": new_vel}

@@ -1,0 +1,12 @@
+"""Test configuration: force an 8-device CPU platform so multi-chip sharding
+paths are exercised without TPU hardware (the strategy SURVEY.md §4 calls for:
+in-process fakes, like the reference's embedded-Hazelcast / Spark local[8]
+harnesses)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

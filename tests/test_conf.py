@@ -1,0 +1,85 @@
+"""Config construction + JSON round-trip tests
+(ref test model: NeuralNetConfigurationTest, MultiLayerNeuralNetConfigurationTest)."""
+
+import pytest
+
+from deeplearning4j_tpu.nn.api import LayerType, OptimizationAlgorithm
+from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.weights import WeightInit
+from deeplearning4j_tpu.ops.losses import LossFunction
+
+
+def test_defaults_match_reference():
+    c = NeuralNetConfiguration()
+    assert c.lr == pytest.approx(0.1)
+    assert c.momentum == pytest.approx(0.5)
+    assert c.use_ada_grad is True
+    assert c.weight_init == WeightInit.VI
+    assert c.loss_function == LossFunction.RECONSTRUCTION_CROSSENTROPY
+    assert c.k == 1
+    assert c.corruption_level == pytest.approx(0.3)
+
+
+def test_json_round_trip_single():
+    c = NeuralNetConfiguration(
+        layer_type=LayerType.OUTPUT,
+        n_in=4,
+        n_out=3,
+        lr=0.05,
+        activation_function="softmax",
+        loss_function=LossFunction.MCXENT,
+        momentum_after={5: 0.9},
+        optimization_algo=OptimizationAlgorithm.CONJUGATE_GRADIENT,
+    )
+    c2 = NeuralNetConfiguration.from_json(c.to_json())
+    assert c2 == c
+
+
+def test_json_round_trip_multi():
+    base = NeuralNetConfiguration(n_in=4, n_out=8, activation_function="tanh")
+    ml = (
+        NeuralNetConfiguration.Builder()
+        .n_in(4)
+        .n_out(8)
+        .activation_function("tanh")
+        .list(3)
+        .hidden_layer_sizes(8, 8)
+        .override(2, layer_type="OUTPUT", n_in=8, n_out=3,
+                  activation_function="softmax", loss_function="MCXENT")
+        .pretrain(False)
+        .backward(True)
+        .build()
+    )
+    assert ml.n_layers == 3
+    assert ml.conf(2).layer_type == LayerType.OUTPUT
+    ml2 = MultiLayerConfiguration.from_json(ml.to_json())
+    assert ml2 == ml
+    assert base.n_in == 4  # base untouched by overrides
+
+
+def test_builder_fluent():
+    c = (
+        NeuralNetConfiguration.Builder()
+        .lr(0.01)
+        .momentum(0.9)
+        .n_in(10)
+        .n_out(5)
+        .build()
+    )
+    assert c.lr == pytest.approx(0.01)
+    assert c.momentum == pytest.approx(0.9)
+
+
+def test_momentum_schedule():
+    c = NeuralNetConfiguration(momentum=0.5, momentum_after={10: 0.9})
+    assert c.momentum_at(0) == pytest.approx(0.5)
+    assert c.momentum_at(10) == pytest.approx(0.9)
+    assert c.momentum_at(50) == pytest.approx(0.9)
+
+
+def test_hashable_for_jit():
+    c1 = NeuralNetConfiguration(n_in=3, n_out=2)
+    c2 = NeuralNetConfiguration(n_in=3, n_out=2)
+    assert hash(c1) == hash(c2)
+    ml = MultiLayerConfiguration(confs=(c1, c2))
+    hash(ml)  # must not raise

@@ -1,0 +1,114 @@
+"""End-to-end MultiLayerNetwork tests on Iris
+(ref test model: nn/multilayer/MultiLayerTest.java, OutputLayerTest)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.impl import IrisDataSetIterator
+from deeplearning4j_tpu.eval import Evaluation
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def iris_mlp_conf(num_iterations=60, lr=0.1):
+    return (
+        NeuralNetConfiguration.Builder()
+        .n_in(4)
+        .n_out(8)
+        .activation_function("tanh")
+        .lr(lr)
+        .momentum(0.9)
+        .use_ada_grad(True)
+        .num_iterations(num_iterations)
+        .seed(42)
+        .weight_init("VI")
+        .list(2)
+        .override(0, layer_type="DENSE")
+        .override(1, layer_type="OUTPUT", n_in=8, n_out=3,
+                  activation_function="softmax", loss_function="MCXENT")
+        .pretrain(False)
+        .backward(True)
+        .build()
+    )
+
+
+def test_init_and_param_shapes():
+    net = MultiLayerNetwork(iris_mlp_conf()).init()
+    p = net.params_tree
+    assert p[0]["W"].shape == (4, 8)
+    assert p[0]["b"].shape == (8,)
+    assert p[1]["W"].shape == (8, 3)
+
+
+def test_params_round_trip():
+    """ref: MultiLayerTest.testSetParams"""
+    net = MultiLayerNetwork(iris_mlp_conf()).init()
+    flat = net.params()
+    assert flat.shape == (4 * 8 + 8 + 8 * 3 + 3,)
+    net2 = MultiLayerNetwork(iris_mlp_conf()).init()
+    net2.set_params(flat)
+    np.testing.assert_allclose(np.asarray(net2.params()), np.asarray(flat), rtol=1e-6)
+
+
+def test_feed_forward_shapes():
+    net = MultiLayerNetwork(iris_mlp_conf()).init()
+    acts = net.feed_forward(np.zeros((5, 4), np.float32))
+    assert [a.shape for a in acts] == [(5, 4), (5, 8), (5, 3)]
+
+
+def test_fit_iris_converges():
+    it = IrisDataSetIterator(150, 150)
+    net = MultiLayerNetwork(iris_mlp_conf(num_iterations=120)).init()
+    data = it.next()
+    before = net.score(data)
+    net.fit(it)
+    after = net.score(data)
+    assert after < before * 0.5, (before, after)
+
+    ev = Evaluation()
+    ev.eval(data.labels, np.asarray(net.output(data.features)))
+    assert ev.accuracy() > 0.85, ev.stats()
+
+
+def test_predict_labels():
+    it = IrisDataSetIterator(150, 150)
+    net = MultiLayerNetwork(iris_mlp_conf(num_iterations=100)).init()
+    net.fit(it)
+    data_it = IrisDataSetIterator(150, 150)
+    d = data_it.next()
+    preds = net.predict(d.features)
+    assert preds.shape == (150,)
+    assert set(np.unique(preds)).issubset({0, 1, 2})
+
+
+def test_merge_parameter_averaging():
+    net1 = MultiLayerNetwork(iris_mlp_conf()).init()
+    net2 = MultiLayerNetwork(iris_mlp_conf()).init()
+    p1 = np.asarray(net1.params())
+    p2 = np.asarray(net2.params())
+    net1.merge(net2, 4)
+    np.testing.assert_allclose(np.asarray(net1.params()), p1 + p2 / 4, rtol=1e-5)
+
+
+def test_save_load_round_trip(tmp_path):
+    net = MultiLayerNetwork(iris_mlp_conf()).init()
+    path = str(tmp_path / "model")
+    net.save(path)
+    loaded = MultiLayerNetwork.load(path)
+    np.testing.assert_allclose(
+        np.asarray(loaded.params()), np.asarray(net.params()), rtol=1e-6
+    )
+    assert loaded.conf == net.conf
+
+
+def test_score_decreases_with_listeners():
+    from deeplearning4j_tpu.optimize.listeners import CollectScoresListener
+
+    it = IrisDataSetIterator(150, 150)
+    net = MultiLayerNetwork(iris_mlp_conf(num_iterations=30)).init()
+    collector = CollectScoresListener()
+    net.set_listeners([collector])
+    net.fit(it)
+    assert len(collector.scores) == 30
+    assert collector.scores[-1][1] < collector.scores[0][1]

@@ -1,0 +1,67 @@
+"""Activation / loss / weight-init substrate tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.weights import WeightInit, init_weights
+from deeplearning4j_tpu.ops.activations import activation, derivative
+from deeplearning4j_tpu.ops.losses import LossFunction, loss, loss_from_logits
+
+
+def test_softmax_rows_sum_to_one():
+    x = jnp.array([[1.0, 2.0, 3.0], [0.0, 0.0, 0.0]])
+    s = activation("softmax")(x)
+    np.testing.assert_allclose(np.sum(np.asarray(s), axis=-1), [1.0, 1.0], rtol=1e-6)
+
+
+def test_sigmoid_derivative():
+    y = activation("sigmoid")(jnp.array([0.3, -1.2]))
+    d = derivative("sigmoid", y)
+    np.testing.assert_allclose(np.asarray(d), np.asarray(y * (1 - y)), rtol=1e-6)
+
+
+def test_unknown_activation_raises():
+    with pytest.raises(ValueError):
+        activation("nope")
+
+
+def test_mcxent_matches_fused():
+    key = jax.random.PRNGKey(0)
+    logits = jax.random.normal(key, (8, 5))
+    labels = jax.nn.one_hot(jnp.arange(8) % 5, 5)
+    probs = jax.nn.softmax(logits, axis=-1)
+    dense = loss(LossFunction.MCXENT, labels, probs)
+    fused = loss_from_logits(LossFunction.MCXENT, labels, logits)
+    np.testing.assert_allclose(float(dense), float(fused), rtol=1e-5)
+
+
+def test_xent_matches_fused():
+    key = jax.random.PRNGKey(1)
+    logits = jax.random.normal(key, (4, 3))
+    labels = (jax.random.uniform(jax.random.PRNGKey(2), (4, 3)) > 0.5).astype(jnp.float32)
+    dense = loss(LossFunction.XENT, labels, jax.nn.sigmoid(logits))
+    fused = loss_from_logits(LossFunction.XENT, labels, logits)
+    np.testing.assert_allclose(float(dense), float(fused), rtol=1e-4)
+
+
+def test_mse_zero_when_equal():
+    y = jnp.ones((3, 2))
+    assert float(loss(LossFunction.MSE, y, y)) == 0.0
+
+
+@pytest.mark.parametrize("scheme", list(WeightInit))
+def test_weight_init_shapes(scheme):
+    w = init_weights(jax.random.PRNGKey(0), (6, 4), scheme, dist=("normal", 0.0, 0.01))
+    assert w.shape == (6, 4)
+    if scheme == WeightInit.ZERO:
+        assert float(jnp.abs(w).sum()) == 0.0
+    else:
+        assert float(jnp.abs(w).sum()) > 0.0
+
+
+def test_vi_range():
+    w = init_weights(jax.random.PRNGKey(0), (100, 100), WeightInit.VI)
+    r = np.sqrt(6.0) / np.sqrt(201.0)
+    assert float(jnp.max(jnp.abs(w))) <= r + 1e-6
