@@ -1,0 +1,95 @@
+"""Benchmark: MNIST MLP training throughput (BASELINE config #1).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+- value: steady-state training samples/sec/chip on the default platform
+  (the real TPU chip under the driver).
+- vs_baseline: ratio vs the same training step measured in a CPU subprocess —
+  the stand-in for the reference's nd4j-native CPU backend (the reference
+  publishes no numbers, BASELINE.md; its jblas CPU path is the comparison
+  point named in BASELINE.json's north star, target ≥5×).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+BATCH = 512
+WARMUP = 5
+MEASURE = 30
+HID1, HID2 = 500, 300
+
+
+def measure(steps: int = MEASURE, batch: int = BATCH) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.datasets.fetchers import synthetic_mnist
+    from deeplearning4j_tpu.models.zoo import mnist_mlp
+    from deeplearning4j_tpu.nn import functional as F
+
+    conf = mnist_mlp(HID1, HID2)
+    params = F.init_params(conf, jax.random.PRNGKey(0))
+    states = F.init_train_state(conf, params)
+    step = F.make_train_step(conf, donate=True)
+
+    xs, ys = synthetic_mnist(batch)
+    x = jnp.asarray(xs)
+    y = jax.nn.one_hot(jnp.asarray(ys), 10, dtype=jnp.float32)
+    key = jax.random.PRNGKey(1)
+
+    for i in range(WARMUP):
+        params, states, score = step(params, states, jnp.asarray(i), x, y, key)
+    jax.block_until_ready(params)
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        params, states, score = step(params, states, jnp.asarray(i), x, y, key)
+    jax.block_until_ready(params)
+    dt = time.perf_counter() - t0
+    assert bool(jnp.isfinite(score)), "non-finite training score"
+    return steps * batch / dt
+
+
+def _cpu_baseline() -> float:
+    """Run the same measurement on CPU in a subprocess (jax config must be
+    flipped before backend init; the ambient sitecustomize pins the TPU)."""
+    code = (
+        "import jax\n"
+        "jax.config.update('jax_platforms','cpu')\n"
+        f"import sys; sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})\n"
+        "from bench import measure\n"
+        "print('CPS', measure(steps=10))\n"
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=600,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        for line in out.stdout.splitlines():
+            if line.startswith("CPS "):
+                return float(line.split()[1])
+    except Exception:
+        pass
+    return 0.0
+
+
+def main() -> None:
+    value = measure()
+    cpu = _cpu_baseline()
+    vs = value / cpu if cpu > 0 else 0.0
+    print(json.dumps({
+        "metric": "mnist_mlp_train_samples_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "samples/sec",
+        "vs_baseline": round(vs, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

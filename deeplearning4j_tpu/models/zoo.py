@@ -1,0 +1,97 @@
+"""Model zoo: the reference's benchmark configurations as ready-made confs.
+
+These mirror BASELINE.json's configs:
+1. 3-layer Dense MLP on MNIST
+2. LeNet-5 (ConvolutionLayer + SubsamplingLayer) on MNIST
+3. Stacked denoising AutoEncoder (pretrain + finetune)
+plus a char-LSTM conf. Built through the same Builder API users see.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration, NeuralNetConfiguration
+
+
+def mnist_mlp(hidden1: int = 500, hidden2: int = 300, lr: float = 0.1,
+              num_iterations: int = 1, seed: int = 42) -> MultiLayerConfiguration:
+    """3-layer MLP (784-h1-h2-10), BASELINE config #1."""
+    return (
+        NeuralNetConfiguration.Builder()
+        .n_in(784).n_out(hidden1).activation_function("relu")
+        .lr(lr).momentum(0.9).use_ada_grad(False)
+        .num_iterations(num_iterations).seed(seed).weight_init("SIZE")
+        .list(3)
+        .override(1, n_in=hidden1, n_out=hidden2)
+        .override(2, layer_type="OUTPUT", n_in=hidden2, n_out=10,
+                  activation_function="softmax", loss_function="MCXENT")
+        .pretrain(False).backward(True)
+        .build()
+    )
+
+
+def lenet(lr: float = 0.05, num_iterations: int = 1, seed: int = 42
+          ) -> MultiLayerConfiguration:
+    """LeNet-5-style conv net for 28x28 MNIST, BASELINE config #2.
+
+    conv5x6 → pool2 → conv5x16 → pool2 → dense120 → dense84 → softmax10
+    (ref conv path: nn/layers/convolution/ConvolutionLayer.java:115-128,
+    subsampling: SubsamplingLayer.java:114-155).
+    """
+    return (
+        NeuralNetConfiguration.Builder()
+        .lr(lr).momentum(0.9).use_ada_grad(False)
+        .num_iterations(num_iterations).seed(seed)
+        .weight_init("SIZE").activation_function("relu")
+        .list(7)
+        .override(0, layer_type="CONVOLUTION", n_in=1, n_out=6, filter_size=(5, 5))
+        .override(1, layer_type="SUBSAMPLING", stride=(2, 2))
+        .override(2, layer_type="CONVOLUTION", n_in=6, n_out=16, filter_size=(5, 5))
+        .override(3, layer_type="SUBSAMPLING", stride=(2, 2))
+        .override(4, layer_type="DENSE", n_in=16 * 4 * 4, n_out=120)
+        .override(5, layer_type="DENSE", n_in=120, n_out=84)
+        .override(6, layer_type="OUTPUT", n_in=84, n_out=10,
+                  activation_function="softmax", loss_function="MCXENT")
+        .input_preprocessor(0, "ff_to_conv")
+        .input_preprocessor(4, "conv_to_ff")
+        .pretrain(False).backward(True)
+        .build()
+    )
+
+
+def stacked_denoising_autoencoder(
+    n_in: int = 784, hidden=(500, 250), n_out: int = 10,
+    corruption_level: float = 0.3, lr: float = 0.1,
+    num_iterations: int = 10, seed: int = 42,
+) -> MultiLayerConfiguration:
+    """SdA: AE layers pretrained greedily, then finetune + backprop
+    (BASELINE config #3; ref workflow MultiLayerNetwork.java:150-191)."""
+    n = len(hidden) + 1
+    b = (
+        NeuralNetConfiguration.Builder()
+        .n_in(n_in).n_out(hidden[0]).activation_function("sigmoid")
+        .lr(lr).corruption_level(corruption_level)
+        .num_iterations(num_iterations).seed(seed)
+        .loss_function("RECONSTRUCTION_CROSSENTROPY")
+        .list(n)
+    )
+    prev = hidden[0]
+    b.override(0, layer_type="AUTOENCODER")
+    for i, h in enumerate(hidden[1:], start=1):
+        b.override(i, layer_type="AUTOENCODER", n_in=prev, n_out=h)
+        prev = h
+    b.override(n - 1, layer_type="OUTPUT", n_in=prev, n_out=n_out,
+               activation_function="softmax", loss_function="MCXENT")
+    return b.pretrain(True).backward(True).build()
+
+
+def char_lstm(vocab: int = 64, hidden: int = 128, seed: int = 42
+              ) -> MultiLayerConfiguration:
+    """Karpathy-style char LSTM (ref: nn/layers/recurrent/LSTM.java)."""
+    return (
+        NeuralNetConfiguration.Builder()
+        .lr(0.1).seed(seed).activation_function("tanh")
+        .list(1)
+        .override(0, layer_type="LSTM", n_in=vocab, n_out=hidden)
+        .pretrain(False).backward(False)
+        .build()
+    )

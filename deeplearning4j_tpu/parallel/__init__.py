@@ -1,0 +1,2 @@
+from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh, mesh_2d  # noqa: F401
+from deeplearning4j_tpu.parallel.trainer import ParameterAveragingTrainer  # noqa: F401

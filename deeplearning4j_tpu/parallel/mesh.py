@@ -1,0 +1,45 @@
+"""Device-mesh helpers.
+
+The reference's "cluster" is an Akka/Spark/YARN worker set exchanging flat
+param vectors through Hazelcast/broadcast/Avro (SURVEY.md §2.5). The TPU
+equivalent is a ``jax.sharding.Mesh`` over chips; gradient/param exchange is
+in-graph XLA collectives over ICI, not host serialization.
+
+Axis names used throughout the framework:
+- "data"  — data parallelism (the reference's only axis)
+- "model" — tensor parallelism (new, TPU-idiomatic)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def data_parallel_mesh(n_devices: Optional[int] = None,
+                       devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over all (or the first n) devices: pure DP."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (DATA_AXIS,))
+
+
+def mesh_2d(dp: int, tp: int, devices: Optional[Sequence] = None) -> Mesh:
+    """dp×tp mesh: batch over "data", hidden dims over "model"."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if dp * tp > len(devs):
+        raise ValueError(f"mesh {dp}x{tp} needs {dp*tp} devices, have {len(devs)}")
+    arr = np.array(devs[: dp * tp]).reshape(dp, tp)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()

@@ -1,0 +1,62 @@
+"""Sharding rules: map network params onto a mesh.
+
+The reference has no tensor parallelism (SURVEY.md §2.5) — this is the
+TPU-idiomatic extension. Dense/Output layer weights are sharded over the
+"model" axis in alternating Megatron style (column-parallel then
+row-parallel), so the activation stays sharded between consecutive layers and
+XLA inserts a single reduce-scatter/all-gather pair per layer pair over ICI.
+Conv/LSTM/pretrain layers stay replicated (their param sizes in this model
+family are small).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.nn.api import LayerType
+from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.params import BIAS_KEY, WEIGHT_KEY
+from deeplearning4j_tpu.parallel.mesh import MODEL_AXIS
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def param_shardings(conf: MultiLayerConfiguration, mesh: Mesh) -> Tuple[dict, ...]:
+    """Per-layer {param_name: NamedSharding}. If the mesh has no "model"
+    axis (pure DP), everything is replicated."""
+    has_tp = MODEL_AXIS in mesh.axis_names and mesh.shape[MODEL_AXIS] > 1
+    out = []
+    col_parallel = True  # alternate column/row parallel across dense layers
+    for i in range(conf.n_layers):
+        layer_conf = conf.conf(i)
+        shardings = {}
+        if has_tp and layer_conf.layer_type in (LayerType.DENSE, LayerType.OUTPUT):
+            tp = mesh.shape[MODEL_AXIS]
+            if col_parallel and layer_conf.n_out % tp == 0:
+                shardings[WEIGHT_KEY] = NamedSharding(mesh, P(None, MODEL_AXIS))
+                shardings[BIAS_KEY] = NamedSharding(mesh, P(MODEL_AXIS))
+                col_parallel = False
+            elif not col_parallel and layer_conf.n_in % tp == 0:
+                shardings[WEIGHT_KEY] = NamedSharding(mesh, P(MODEL_AXIS, None))
+                shardings[BIAS_KEY] = NamedSharding(mesh, P())
+                col_parallel = True
+        # everything not explicitly sharded is replicated
+        out.append(shardings)
+    return tuple(out)
+
+
+def apply_shardings(params, shardings_per_layer, mesh: Mesh):
+    """Place a params pytree according to param_shardings."""
+    import jax
+
+    rep = replicated(mesh)
+    placed = []
+    for layer_params, shardings in zip(params, shardings_per_layer):
+        placed.append({
+            k: jax.device_put(v, shardings.get(k, rep)) for k, v in layer_params.items()
+        })
+    return tuple(placed)

@@ -1,0 +1,206 @@
+"""Data-parallel training with in-graph parameter averaging.
+
+Parity with the reference's two ParameterAveraging modes
+(ref: spark/impl/multilayer/SparkDl4jMultiLayer.java:157-203):
+
+- ``average_each_iteration=True`` — gradients are pmean'd across the "data"
+  mesh axis every step (the reference's per-iteration re-broadcast loop,
+  :183-203, and the Akka IterativeReduceWorkRouter semantics). This is
+  standard synchronous DP-SGD: one XLA AllReduce over ICI per step.
+
+- ``average_each_iteration=False`` (reference default, :157-176) — each
+  device runs a full local fit (``local_iterations`` steps on its own shard,
+  no cross-device traffic; the IterativeReduceFlatMap worker), then params
+  are pmean'd once (the driver-side fold/÷N — here a single in-graph
+  AllReduce instead of a host gather).
+
+The Hogwild router (ref: workrouter/HogWildWorkRouter.java) has no XLA-shaped
+equivalent — lock-free shared-memory updates contradict SPMD. Its purpose
+(staleness-tolerant throughput) is served by the per-fit mode; see
+scaleout/ for the API-parity shim.
+
+Implementation: ``shard_map`` over a Mesh; batch sharded on "data"; params
+replicated (combine with parallel/sharding.py TP shardings via pjit for 2-D
+meshes — see make_pjit_train_step).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+from deeplearning4j_tpu.nn import functional as F
+from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.updater import apply_updater
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
+
+Array = jax.Array
+
+
+def _local_grad_step(conf, params, states, iteration, x, y, key, pmean_grads: bool):
+    """One update step; optionally pmean the grads across the data axis."""
+    kdrop, _ = jax.random.split(key)
+
+    def loss_fn(ps):
+        return F.network_loss(conf, ps, x, y, train=True, key=kdrop)
+
+    score, grads = jax.value_and_grad(loss_fn)(params)
+    if pmean_grads:
+        grads = jax.lax.pmean(grads, DATA_AXIS)
+        score = jax.lax.pmean(score, DATA_AXIS)
+    new_params = []
+    new_states = []
+    for i in range(conf.n_layers):
+        upd, st = apply_updater(conf.conf(i), iteration, grads[i], params[i], states[i])
+        new_params.append(jax.tree_util.tree_map(lambda p, u: p - u, params[i], upd))
+        new_states.append(st)
+    return tuple(new_params), tuple(new_states), score
+
+
+def make_sync_train_step(conf: MultiLayerConfiguration, mesh: Mesh):
+    """Per-step averaging: grads AllReduced every iteration."""
+
+    def step(params, states, iteration, x, y, key):
+        return _local_grad_step(conf, params, states, iteration, x, y, key, True)
+
+    sharded = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS), P()),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0, 1))
+
+
+def make_local_fit_step(conf: MultiLayerConfiguration, mesh: Mesh,
+                        local_iterations: int):
+    """Per-fit averaging: each device runs `local_iterations` steps on its own
+    shard with zero cross-device traffic, then params/states are pmean'd once."""
+
+    def local_fit(params, states, iteration0, x, y, key):
+        def body(carry, i):
+            params, states = carry
+            step_key = jax.random.fold_in(key, i)
+            params, states, score = _local_grad_step(
+                conf, params, states, iteration0 + i, x, y, step_key, False
+            )
+            return (params, states), score
+
+        (params, states), scores = jax.lax.scan(
+            body, (params, states), jnp.arange(local_iterations)
+        )
+        # the single aggregation round: in-graph AllReduce replaces the
+        # reference's results.fold(zeros, Add) ÷ numPartitions on the driver
+        params = jax.lax.pmean(params, DATA_AXIS)
+        states = jax.lax.pmean(states, DATA_AXIS)
+        return params, states, jax.lax.pmean(scores[-1], DATA_AXIS)
+
+    sharded = jax.shard_map(
+        local_fit,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS), P()),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0, 1))
+
+
+class ParameterAveragingTrainer:
+    """Facade mirroring SparkDl4jMultiLayer: wraps a MultiLayerNetwork and a
+    mesh, trains data-parallel, leaves averaged params in the network.
+
+    ``average_each_iteration`` matches the reference's
+    ``org.deeplearning4j.spark.iteration.average`` SparkConf flag.
+    """
+
+    def __init__(
+        self,
+        net: MultiLayerNetwork,
+        mesh: Optional[Mesh] = None,
+        average_each_iteration: bool = False,
+        local_iterations: Optional[int] = None,
+    ):
+        from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
+
+        self.net = net
+        self.mesh = mesh if mesh is not None else data_parallel_mesh()
+        self.average_each_iteration = average_each_iteration
+        self.local_iterations = (
+            local_iterations
+            if local_iterations is not None
+            else net.conf.conf(0).num_iterations
+        )
+        self._sync_step = None
+        self._fit_step = None
+        self._iteration = 0
+
+    @property
+    def n_devices(self) -> int:
+        return int(self.mesh.size)
+
+    def _pad_to_devices(self, x):
+        """Pad the batch so it divides the data-axis size (the reference
+        repartitions the RDD to the worker count, :164)."""
+        n = x.shape[0]
+        d = self.mesh.shape[DATA_AXIS]
+        rem = n % d
+        if rem == 0:
+            return x, n
+        pad = d - rem
+        reps = jnp.concatenate([x, jnp.repeat(x[-1:], pad, axis=0)], axis=0)
+        return reps, n
+
+    def fit_data_set(self, data: DataSetIterator) -> None:
+        """ref: SparkDl4jMultiLayer.fitDataSet(JavaRDD<DataSet>)."""
+        net = self.net
+        net._ensure_train_step()
+        rep = NamedSharding(self.mesh, P())
+        # explicit copies: the steps donate their inputs, and the facade (or a
+        # clone) may still reference the original buffers
+        params = jax.device_put(
+            jax.tree_util.tree_map(jnp.array, net.params_tree), rep
+        )
+        states = jax.device_put(
+            jax.tree_util.tree_map(jnp.array, net._train_state), rep
+        )
+
+        if self.average_each_iteration:
+            if self._sync_step is None:
+                self._sync_step = make_sync_train_step(net.conf, self.mesh)
+            step = self._sync_step
+            for batch in data:
+                x, _ = self._pad_to_devices(jnp.asarray(batch.features))
+                y, _ = self._pad_to_devices(jnp.asarray(batch.labels))
+                params, states, score = step(
+                    params, states, jnp.asarray(self._iteration), x, y,
+                    net._keys.next(),
+                )
+                self._iteration += 1
+                for listener in net.listeners:
+                    listener(net, self._iteration, float(score))
+        else:
+            if self._fit_step is None:
+                self._fit_step = make_local_fit_step(
+                    net.conf, self.mesh, self.local_iterations
+                )
+            step = self._fit_step
+            for batch in data:
+                x, _ = self._pad_to_devices(jnp.asarray(batch.features))
+                y, _ = self._pad_to_devices(jnp.asarray(batch.labels))
+                params, states, score = step(
+                    params, states, jnp.asarray(self._iteration), x, y,
+                    net._keys.next(),
+                )
+                self._iteration += self.local_iterations
+                for listener in net.listeners:
+                    listener(net, self._iteration, float(score))
+
+        net._params = jax.tree_util.tree_map(lambda a: a, params)
+        net._train_state = states

@@ -1,12 +1,14 @@
 """Test configuration: force an 8-device CPU platform so multi-chip sharding
 paths are exercised without TPU hardware (the strategy SURVEY.md §4 calls for:
 in-process fakes, like the reference's embedded-Hazelcast / Spark local[8]
-harnesses)."""
+harnesses).
 
-import os
+Note: the ambient sitecustomize registers the axon TPU plugin and pins
+``jax_platforms`` programmatically, so env vars alone don't stick — the
+override must go through jax.config before first backend use.
+"""
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
