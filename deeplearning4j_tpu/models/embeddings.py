@@ -1,0 +1,81 @@
+"""Embedding lookup table + serialization.
+
+Parity with ref: models/embeddings/inmemory/InMemoryLookupTable.java:51-66
+(syn0/syn1 for hierarchical softmax, syn1neg + unigram table for negative
+sampling) and models/embeddings/loader/WordVectorSerializer.java (word2vec
+text format round-trip).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.text.vocab import VocabCache, VocabWord, build_huffman
+
+UNIGRAM_TABLE_SIZE = 1 << 20
+UNIGRAM_POWER = 0.75
+
+
+class InMemoryLookupTable:
+    """Host-resident master copy of the embedding matrices; device copies are
+    made per training run (the arrays are donated into the jitted steps)."""
+
+    def __init__(self, vocab: VocabCache, layer_size: int, seed: int = 123,
+                 use_hs: bool = True, negative: int = 0):
+        self.vocab = vocab
+        self.layer_size = layer_size
+        self.use_hs = use_hs
+        self.negative = negative
+        rng = np.random.default_rng(seed)
+        n = vocab.num_words()
+        # ref resetWeights: syn0 ~ U(-0.5,0.5)/layerSize, syn1 zeros
+        self.syn0 = ((rng.random((n, layer_size)) - 0.5) / layer_size).astype(np.float32)
+        self.syn1 = np.zeros((max(n - 1, 1), layer_size), dtype=np.float32)
+        self.syn1neg = np.zeros((n, layer_size), dtype=np.float32)
+        self._unigram: Optional[np.ndarray] = None
+
+    def unigram_probs(self) -> np.ndarray:
+        """Unigram^0.75 sampling distribution (ref: InMemoryLookupTable table)."""
+        if self._unigram is None:
+            counts = self.vocab.counts() ** UNIGRAM_POWER
+            self._unigram = (counts / counts.sum()).astype(np.float32)
+        return self._unigram
+
+    def vector(self, word: str) -> Optional[np.ndarray]:
+        idx = self.vocab.index_of(word)
+        return None if idx < 0 else self.syn0[idx]
+
+
+# ------------------------------------------------------------ serializer ----
+
+def write_word_vectors(table: InMemoryLookupTable, path: str) -> None:
+    """word2vec text format: header 'V D', then 'word f f f ...'
+    (ref: WordVectorSerializer.writeWordVectors)."""
+    with open(path, "w", encoding="utf-8") as f:
+        n, d = table.syn0.shape
+        f.write(f"{n} {d}\n")
+        for i in range(n):
+            vec = " ".join(f"{x:.6f}" for x in table.syn0[i])
+            f.write(f"{table.vocab.word_at(i)} {vec}\n")
+
+
+def load_word_vectors(path: str) -> Tuple[VocabCache, np.ndarray]:
+    """(ref: WordVectorSerializer.loadTxtVectors). Vocab indices follow file
+    order (which write_word_vectors emits in index order)."""
+    vocab = VocabCache()
+    vecs: List[np.ndarray] = []
+    with open(path, "r", encoding="utf-8") as f:
+        header = f.readline().split()
+        n, d = int(header[0]), int(header[1])
+        for i, line in enumerate(f):
+            parts = line.rstrip().split(" ")
+            vw = VocabWord(parts[0], count=1, index=i)
+            vocab._words[vw.word] = vw
+            vocab._index.append(vw)
+            vecs.append(np.array([float(x) for x in parts[1 : d + 1]], np.float32))
+    mat = np.stack(vecs) if vecs else np.zeros((0, d), np.float32)
+    assert mat.shape == (n, d), f"header {(n, d)} vs data {mat.shape}"
+    return vocab, mat

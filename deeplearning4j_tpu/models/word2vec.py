@@ -1,0 +1,286 @@
+"""Word2Vec skip-gram — TPU-shaped.
+
+Parity surface: ref models/word2vec/Word2Vec.java — fit() builds the vocab
+(Huffman coding via Word2Vec.java:353), then trains skip-gram with
+hierarchical softmax and/or negative sampling
+(InMemoryLookupTable.iterate, InMemoryLookupTable.java:165-236), with
+lr decay by words processed (:85) and frequent-word subsampling (:224).
+
+TPU-first redesign (SURVEY.md §7 hard part (c)): the reference's hot loop is
+a per-(word, tree-node) dot+axpy on 50-dim vectors — pure sequential BLAS-1.
+Here training is *batched*: the host generates (center, context) skip-gram
+pairs for a chunk of sentences; the device runs one jitted step per
+fixed-size batch that
+- gathers all embeddings for the batch,
+- computes the closed-form SGNS / hierarchical-softmax gradients as one
+  (B,K+1,D)-shaped einsum block on the MXU,
+- applies updates with scatter-add (``.at[].add``), and
+- samples negatives in-graph from the unigram^0.75 distribution.
+Collisions between duplicate indices in one batch resolve by addition —
+the same semantics as the reference's racy Hogwild updates.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.models.embeddings import InMemoryLookupTable
+from deeplearning4j_tpu.text.sentence_iterator import SentenceIterator
+from deeplearning4j_tpu.text.tokenization import DefaultTokenizerFactory, TokenizerFactory
+from deeplearning4j_tpu.text.vocab import VocabCache, build_huffman
+
+
+# ------------------------------------------------------------ jitted steps ----
+
+@partial(jax.jit, static_argnames=("negative",), donate_argnums=(0, 1))
+def _sgns_step(syn0, syn1neg, centers, contexts, weights, probs_logits, lr, key,
+               negative: int):
+    """One negative-sampling step. centers/contexts: (B,), weights: (B,) 0/1
+    mask for padding; probs_logits: (V,) log-unigram^0.75."""
+    b = centers.shape[0]
+    negs = jax.random.categorical(key, probs_logits, shape=(b, negative))
+    v = syn0[centers]                       # (B,D)
+    u_pos = syn1neg[contexts]               # (B,D)
+    u_neg = syn1neg[negs]                   # (B,K,D)
+
+    pos_score = jax.nn.sigmoid(jnp.sum(v * u_pos, axis=-1))          # (B,)
+    neg_score = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", v, u_neg))   # (B,K)
+
+    g_pos = (pos_score - 1.0) * weights                              # (B,)
+    g_neg = neg_score * weights[:, None]                             # (B,K)
+
+    grad_v = g_pos[:, None] * u_pos + jnp.einsum("bk,bkd->bd", g_neg, u_neg)
+    grad_u_pos = g_pos[:, None] * v
+    grad_u_neg = g_neg[..., None] * v[:, None, :]
+
+    syn0 = syn0.at[centers].add(-lr * grad_v)
+    syn1neg = syn1neg.at[contexts].add(-lr * grad_u_pos)
+    syn1neg = syn1neg.at[negs.reshape(-1)].add(
+        -lr * grad_u_neg.reshape(-1, grad_u_neg.shape[-1])
+    )
+    eps = 1e-7
+    loss = -(jnp.log(pos_score + eps) * weights).sum() - (
+        jnp.log(1.0 - neg_score + eps) * weights[:, None]
+    ).sum()
+    return syn0, syn1neg, loss
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _hs_step(syn0, syn1, centers, points, codes, mask, weights, lr):
+    """One hierarchical-softmax step. points/codes/mask: (B,L) padded Huffman
+    paths; labels are 1-code (word2vec convention, ref iterate())."""
+    v = syn0[centers]                       # (B,D)
+    u = syn1[points]                        # (B,L,D)
+    score = jax.nn.sigmoid(jnp.einsum("bd,bld->bl", v, u))
+    labels = 1.0 - codes
+    g = (score - labels) * mask * weights[:, None]   # (B,L)
+
+    grad_v = jnp.einsum("bl,bld->bd", g, u)
+    grad_u = g[..., None] * v[:, None, :]
+
+    syn0 = syn0.at[centers].add(-lr * grad_v)
+    syn1 = syn1.at[points.reshape(-1)].add(-lr * grad_u.reshape(-1, grad_u.shape[-1]))
+    eps = 1e-7
+    loss = -jnp.sum(
+        (labels * jnp.log(score + eps) + (1 - labels) * jnp.log(1 - score + eps))
+        * mask * weights[:, None]
+    )
+    return syn0, syn1, loss
+
+
+# ----------------------------------------------------------------- model ----
+
+class Word2Vec:
+    def __init__(
+        self,
+        sentence_iterator: Optional[SentenceIterator] = None,
+        tokenizer_factory: Optional[TokenizerFactory] = None,
+        layer_size: int = 50,
+        window: int = 5,
+        min_word_frequency: int = 1,
+        negative: int = 5,
+        use_hierarchic_softmax: bool = False,
+        lr: float = 0.025,
+        min_lr: float = 1e-4,
+        iterations: int = 1,
+        sample: float = 1e-3,
+        batch_size: int = 2048,
+        seed: int = 123,
+    ):
+        self.sentence_iterator = sentence_iterator
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+        self.layer_size = layer_size
+        self.window = window
+        self.min_word_frequency = min_word_frequency
+        self.negative = negative
+        self.use_hs = use_hierarchic_softmax
+        if not use_hierarchic_softmax and negative <= 0:
+            raise ValueError("need negative sampling and/or hierarchical softmax")
+        self.lr = lr
+        self.min_lr = min_lr
+        self.iterations = iterations
+        self.sample = sample
+        self.batch_size = batch_size
+        self.seed = seed
+        self.vocab = VocabCache()
+        self.lookup_table: Optional[InMemoryLookupTable] = None
+        self.total_words_trained = 0
+
+    # ---- vocab ----
+    def build_vocab(self) -> None:
+        """Tokenize all sentences, count, prune, Huffman-code
+        (ref: Word2Vec.fit vocab phase + Huffman.java)."""
+        assert self.sentence_iterator is not None, "no sentence iterator configured"
+        for sentence in self.sentence_iterator:
+            for tok in self.tokenizer_factory.create(sentence).get_tokens():
+                self.vocab.add_token(tok)
+        self.vocab.finish(self.min_word_frequency)
+        build_huffman(self.vocab)
+        self.lookup_table = InMemoryLookupTable(
+            self.vocab, self.layer_size, seed=self.seed,
+            use_hs=self.use_hs, negative=self.negative,
+        )
+
+    # ---- pair generation (host side) ----
+    def _sentence_indices(self, rng: np.random.Generator) -> List[np.ndarray]:
+        sents = []
+        total = max(self.vocab.total_word_count(), 1)
+        counts = self.vocab.counts()
+        # subsampling keep-probability per word (ref: Word2Vec.java:224)
+        if self.sample > 0:
+            freq = counts / total
+            keep = np.minimum(1.0, np.sqrt(self.sample / np.maximum(freq, 1e-12)))
+        else:
+            keep = np.ones_like(counts)
+        for sentence in self.sentence_iterator:
+            idx = [
+                self.vocab.index_of(t)
+                for t in self.tokenizer_factory.create(sentence).get_tokens()
+            ]
+            idx = np.array([i for i in idx if i >= 0], dtype=np.int32)
+            if self.sample > 0 and idx.size:
+                idx = idx[rng.random(idx.size) < keep[idx]]
+            if idx.size >= 2:
+                sents.append(idx)
+        return sents
+
+    def _skipgram_pairs(self, sents: Sequence[np.ndarray],
+                        rng: np.random.Generator) -> Tuple[np.ndarray, np.ndarray]:
+        centers: List[np.ndarray] = []
+        contexts: List[np.ndarray] = []
+        for idx in sents:
+            n = idx.size
+            # random reduced window per position (word2vec/ref behavior)
+            b = rng.integers(1, self.window + 1, size=n)
+            for i in range(n):
+                lo, hi = max(0, i - b[i]), min(n, i + b[i] + 1)
+                ctx = np.concatenate([idx[lo:i], idx[i + 1:hi]])
+                if ctx.size:
+                    centers.append(np.full(ctx.size, idx[i], np.int32))
+                    contexts.append(ctx.astype(np.int32))
+        if not centers:
+            return np.zeros(0, np.int32), np.zeros(0, np.int32)
+        return np.concatenate(centers), np.concatenate(contexts)
+
+    # ---- training ----
+    def fit(self) -> None:
+        if self.lookup_table is None:
+            self.build_vocab()
+        table = self.lookup_table
+        rng = np.random.default_rng(self.seed)
+        key = jax.random.PRNGKey(self.seed)
+
+        syn0 = jnp.asarray(table.syn0)
+        syn1 = jnp.asarray(table.syn1)
+        syn1neg = jnp.asarray(table.syn1neg)
+        probs_logits = jnp.log(jnp.asarray(table.unigram_probs()) + 1e-12)
+
+        # padded Huffman path matrices for HS
+        if self.use_hs:
+            max_len = max((len(w.code) for w in self.vocab.words()), default=1)
+            n = self.vocab.num_words()
+            pts = np.zeros((n, max_len), np.int32)
+            cds = np.zeros((n, max_len), np.float32)
+            msk = np.zeros((n, max_len), np.float32)
+            for w in self.vocab.words():
+                path_len = len(w.code)
+                pts[w.index, :path_len] = w.points
+                cds[w.index, :path_len] = w.code
+                msk[w.index, :path_len] = 1.0
+            pts_j, cds_j, msk_j = jnp.asarray(pts), jnp.asarray(cds), jnp.asarray(msk)
+
+        total_words = self.vocab.total_word_count() * max(self.iterations, 1)
+        words_seen = 0
+        bsz = self.batch_size
+
+        for _ in range(max(self.iterations, 1)):
+            sents = self._sentence_indices(rng)
+            rng.shuffle(sents)
+            centers, contexts = self._skipgram_pairs(sents, rng)
+            n_pairs = centers.shape[0]
+            for start in range(0, n_pairs, bsz):
+                c = centers[start : start + bsz]
+                t = contexts[start : start + bsz]
+                w = np.ones(c.shape[0], np.float32)
+                if c.shape[0] < bsz:  # pad the final batch, mask the padding
+                    pad = bsz - c.shape[0]
+                    c = np.concatenate([c, np.zeros(pad, np.int32)])
+                    t = np.concatenate([t, np.zeros(pad, np.int32)])
+                    w = np.concatenate([w, np.zeros(pad, np.float32)])
+                # linear lr decay by words processed (ref: Word2Vec.java:85)
+                frac = min(words_seen / max(total_words, 1), 1.0)
+                lr = max(self.min_lr, self.lr * (1.0 - frac))
+                cj, tj, wj = jnp.asarray(c), jnp.asarray(t), jnp.asarray(w)
+                if self.negative > 0:
+                    key, sub = jax.random.split(key)
+                    syn0, syn1neg, _ = _sgns_step(
+                        syn0, syn1neg, cj, tj, wj, probs_logits,
+                        jnp.float32(lr), sub, self.negative,
+                    )
+                if self.use_hs:
+                    syn0, syn1, _ = _hs_step(
+                        syn0, syn1, cj, pts_j[tj], cds_j[tj], msk_j[tj], wj,
+                        jnp.float32(lr),
+                    )
+                words_seen += int(w.sum())
+        table.syn0 = np.asarray(syn0)
+        table.syn1 = np.asarray(syn1)
+        table.syn1neg = np.asarray(syn1neg)
+        self.total_words_trained = words_seen
+
+    # ---- query API (ref: WordVectors interface) ----
+    def word_vector(self, word: str) -> Optional[np.ndarray]:
+        return self.lookup_table.vector(word) if self.lookup_table else None
+
+    def has_word(self, word: str) -> bool:
+        return self.vocab.contains(word)
+
+    def similarity(self, w1: str, w2: str) -> float:
+        v1, v2 = self.word_vector(w1), self.word_vector(w2)
+        if v1 is None or v2 is None:
+            return float("nan")
+        denom = np.linalg.norm(v1) * np.linalg.norm(v2)
+        return float(np.dot(v1, v2) / denom) if denom else 0.0
+
+    def words_nearest(self, word: str, n: int = 10) -> List[str]:
+        v = self.word_vector(word)
+        if v is None:
+            return []
+        syn0 = self.lookup_table.syn0
+        norms = np.linalg.norm(syn0, axis=1) * (np.linalg.norm(v) + 1e-12)
+        sims = syn0 @ v / np.maximum(norms, 1e-12)
+        order = np.argsort(-sims)
+        out = []
+        for i in order:
+            w = self.vocab.word_at(int(i))
+            if w != word:
+                out.append(w)
+            if len(out) >= n:
+                break
+        return out

@@ -1,0 +1,17 @@
+"""Scaleout: distributed-training contracts and runtimes.
+
+Parity with the reference's deeplearning4j-scaleout tree (SURVEY.md §2.2):
+transport-agnostic contracts (Job, WorkerPerformer, JobAggregator,
+StateTracker, WorkRouter, Updateable) plus a local in-process runtime that
+replaces the Akka/Hazelcast/Spark/YARN stacks.
+
+TPU-first position: on TPU pods the *data plane* (gradient/param exchange) is
+in-graph XLA collectives — parallel/trainer.py — not host serialization. This
+package keeps the reference's *control plane* API so orchestration code
+(routers, aggregation policy, model saving, job feeding) ports over, and its
+workers can drive either host-level fits or the collective trainer.
+"""
+
+from deeplearning4j_tpu.scaleout.job import Job  # noqa: F401
+from deeplearning4j_tpu.scaleout.statetracker import InMemoryStateTracker  # noqa: F401
+from deeplearning4j_tpu.scaleout.runner import LocalDistributedRunner  # noqa: F401

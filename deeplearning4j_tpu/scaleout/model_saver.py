@@ -1,0 +1,56 @@
+"""ModelSaver SPI — checkpoint the aggregated model.
+
+Parity with ref: actor/core/ModelSaver / DefaultModelSaver (java serialization
+to file, saved by ModelSavingActor on every aggregation round). Format here is
+the framework checkpoint (conf JSON + flat params npz), the same one
+MultiLayerNetwork.save/load uses.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+
+class ModelSaver:
+    def save(self, model) -> None:
+        raise NotImplementedError
+
+    def load(self):
+        raise NotImplementedError
+
+
+class FileModelSaver(ModelSaver):
+    """ref: actor/core/DefaultModelSaver.java"""
+
+    def __init__(self, path: str = "nn-model.npz"):
+        self.path = path if path.endswith(".npz") else path + ".npz"
+
+    def save(self, model) -> None:
+        model.save(self.path)
+
+    def load(self):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        return MultiLayerNetwork.load(self.path)
+
+    def exists(self) -> bool:
+        return os.path.exists(self.path)
+
+
+class ParamsOnlySaver(ModelSaver):
+    """Save just the flat parameter vector (ref: CLI Train writes params
+    binary via Nd4j.write, cli/subcommands/Train.java)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def save(self, model) -> None:
+        np.save(self.path if self.path.endswith(".npy") else self.path + ".npy",
+                np.asarray(model.params()))
+
+    def load(self):
+        p = self.path if self.path.endswith(".npy") else self.path + ".npy"
+        return np.load(p)

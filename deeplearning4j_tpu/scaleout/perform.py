@@ -1,0 +1,49 @@
+"""WorkerPerformer — worker-side compute.
+
+Parity with ref: scaleout/perform/WorkerPerformer.java {perform(Job),
+update(Object...)} and the Akka BaseMultiLayerNetworkWorkPerformer (fromJson
+conf → net.setParameters(current) → net.fit(DataSet) → result = params).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.scaleout.job import Job
+
+
+class WorkerPerformer:
+    def perform(self, job: Job) -> None:
+        raise NotImplementedError
+
+    def update(self, *args) -> None:
+        raise NotImplementedError
+
+
+class MultiLayerNetworkWorkPerformer(WorkerPerformer):
+    """Fit a network on the job's DataSet; result = flat params
+    (ref: perform/BaseMultiLayerNetworkWorkPerformer.java)."""
+
+    def __init__(self, conf_json: str):
+        self.conf_json = conf_json
+        self._params: Optional[np.ndarray] = None
+
+    def perform(self, job: Job) -> None:
+        net = MultiLayerNetwork.from_json(self.conf_json)
+        net.init()
+        if self._params is not None:
+            net.set_params(self._params)
+        data = job.work
+        if not isinstance(data, DataSet):
+            raise TypeError(f"expected DataSet work, got {type(data)}")
+        net.fit(data)
+        job.result = np.asarray(net.params())
+
+    def update(self, *args) -> None:
+        """Receive the averaged master params (ref: performer.update)."""
+        if args:
+            self._params = np.asarray(args[0])

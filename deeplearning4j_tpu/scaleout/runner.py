@@ -1,0 +1,110 @@
+"""Local distributed runtime — master/worker training in one process.
+
+Parity with ref: actor/runner/DeepLearning4jDistributed.java + the
+MasterActor/WorkerActor heartbeat protocol (MasterActor.java:106-142,
+WorkerActor.java:168-206), replacing Akka actors + Hazelcast with a thread
+pool + InMemoryStateTracker — exactly how the reference's own tests run the
+cluster (testsupport/BaseTestDistributed.java: everything in one JVM).
+
+Round protocol per heartbeat:
+  master: if router.send_work(): aggregate updates (router.update), feed next
+          jobs from the JobIterator
+  worker: if tracker.needs_replicate(id): pull current params
+          (performer.update); take job; performer.perform(job);
+          tracker.add_update(id, job)
+
+On TPU silicon prefer parallel/trainer.py (in-graph collectives). This runner
+is the control-plane-parity path and also the host-level orchestration for
+multi-process setups.
+"""
+
+from __future__ import annotations
+
+import logging
+from concurrent.futures import ThreadPoolExecutor, wait
+from typing import Optional
+
+from deeplearning4j_tpu.scaleout.aggregator import ParameterAveragingAggregator
+from deeplearning4j_tpu.scaleout.job import JobIterator
+from deeplearning4j_tpu.scaleout.model_saver import ModelSaver
+from deeplearning4j_tpu.scaleout.perform import WorkerPerformer
+from deeplearning4j_tpu.scaleout.statetracker import InMemoryStateTracker
+from deeplearning4j_tpu.scaleout.workrouter import IterativeReduceWorkRouter, WorkRouter
+
+log = logging.getLogger(__name__)
+
+
+class LocalDistributedRunner:
+    def __init__(
+        self,
+        performer_factory,
+        job_iterator: JobIterator,
+        num_workers: int = 4,
+        router: Optional[WorkRouter] = None,
+        tracker: Optional[InMemoryStateTracker] = None,
+        model_saver: Optional[ModelSaver] = None,
+        max_rounds: int = 10_000,
+    ):
+        """performer_factory() -> WorkerPerformer (one per worker, mirroring
+        WorkerPerformerFactory, ref: scaleout/perform/WorkerPerformerFactory)."""
+        self.tracker = tracker or InMemoryStateTracker()
+        self.router = router or IterativeReduceWorkRouter(
+            self.tracker, ParameterAveragingAggregator()
+        )
+        self.performers = {
+            f"worker-{i}": performer_factory() for i in range(num_workers)
+        }
+        self.job_iterator = job_iterator
+        self.model_saver = model_saver
+        self.max_rounds = max_rounds
+        for worker_id in self.performers:
+            self.tracker.add_worker(worker_id)
+
+    def _worker_round(self, worker_id: str) -> None:
+        performer: WorkerPerformer = self.performers[worker_id]
+        if self.tracker.needs_replicate(worker_id):
+            current = self.tracker.get_current()
+            if current is not None:
+                performer.update(current)
+            self.tracker.done_replicating(worker_id)
+        job = self.tracker.job_for(worker_id)
+        if job is None:
+            return
+        performer.perform(job)
+        self.tracker.add_update(worker_id, job)
+        self.tracker.clear_job(worker_id)
+        self.tracker.increment("jobs_done")
+
+    def train(self):
+        """Run rounds until the JobIterator is exhausted; returns the final
+        averaged flat param vector (tracker current)."""
+        workers = list(self.performers)
+        with ThreadPoolExecutor(max_workers=len(workers)) as pool:
+            rounds = 0
+            while rounds < self.max_rounds:
+                rounds += 1
+                # master: feed one job per worker
+                fed = False
+                for worker_id in workers:
+                    if self.job_iterator.has_next():
+                        self.tracker.add_job(self.job_iterator.next(worker_id))
+                        fed = True
+                if not fed and not self.tracker.has_pending_jobs():
+                    break
+                # workers: one heartbeat each (parallel)
+                futures = [pool.submit(self._worker_round, w) for w in workers]
+                wait(futures)
+                for f in futures:
+                    f.result()  # surface worker exceptions
+                # master: aggregate when router policy allows
+                if self.router.send_work():
+                    self.router.update()
+                    if self.model_saver is not None:
+                        current = self.tracker.get_current()
+                        if current is not None:
+                            self.tracker.increment("aggregations")
+            # final aggregation of any straggler updates
+            if self.tracker.updates():
+                self.router.update()
+        self.tracker.finish()
+        return self.tracker.get_current()

@@ -1,0 +1,52 @@
+"""Work routers — sync vs async aggregation policy.
+
+Parity with ref: scaleout/api/workrouter/BaseWorkRouter.java:47-62 (update():
+aggregate saved updates → setCurrent → mark replicates) and the Akka routers:
+IterativeReduceWorkRouter (send work only when every worker has reported —
+synchronous parameter averaging) and HogWildWorkRouter (always send — async).
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.scaleout.aggregator import JobAggregator
+from deeplearning4j_tpu.scaleout.statetracker import StateTracker
+
+
+class WorkRouter:
+    def __init__(self, tracker: StateTracker, aggregator: JobAggregator):
+        self.tracker = tracker
+        self.aggregator = aggregator
+
+    def send_work(self) -> bool:
+        """Whether the master may hand out the next round of jobs."""
+        raise NotImplementedError
+
+    def update(self) -> None:
+        """Aggregate worker updates into the tracker's current params and
+        flag every worker for replication (ref: BaseWorkRouter.update)."""
+        updates = self.tracker.updates()
+        for job in updates.values():
+            self.aggregator.accumulate(job)
+        result = self.aggregator.aggregate()
+        if result is not None:
+            self.tracker.set_current(result)
+        for worker_id in self.tracker.workers():
+            self.tracker.add_replicate(worker_id)
+        self.tracker.clear_updates()
+        if hasattr(self.aggregator, "reset"):
+            self.aggregator.reset()
+
+
+class IterativeReduceWorkRouter(WorkRouter):
+    """Synchronous: wait for all workers (ref: IterativeReduceWorkRouter.java)."""
+
+    def send_work(self) -> bool:
+        workers = self.tracker.workers()
+        return bool(workers) and len(self.tracker.updates()) >= len(workers)
+
+
+class HogWildWorkRouter(WorkRouter):
+    """Asynchronous: always route (ref: HogWildWorkRouter.java)."""
+
+    def send_work(self) -> bool:
+        return True

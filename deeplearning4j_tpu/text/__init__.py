@@ -1,0 +1,9 @@
+"""Text pipeline (ref: deeplearning4j-nlp text/ packages)."""
+
+from deeplearning4j_tpu.text.tokenization import DefaultTokenizerFactory, NGramTokenizerFactory  # noqa: F401
+from deeplearning4j_tpu.text.sentence_iterator import (  # noqa: F401
+    CollectionSentenceIterator,
+    FileSentenceIterator,
+    LineSentenceIterator,
+)
+from deeplearning4j_tpu.text.vocab import VocabCache, VocabWord  # noqa: F401

@@ -1,0 +1,220 @@
+"""Layer-level tests (ref test models: RBMTests, AutoEncoderTest,
+TestConvolutionLayer, SubsampleTests, LSTMTest)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.api import ConvolutionType, HiddenUnit, VisibleUnit
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import autoencoder as ae
+from deeplearning4j_tpu.nn.layers import convolution, lstm, rbm, subsampling
+from deeplearning4j_tpu.nn.params import init_layer_params
+from deeplearning4j_tpu.optimize.solver import Solver
+
+
+# ----------------------------------------------------------------- conv ----
+
+def conv_conf(**kw):
+    kw.setdefault("layer_type", "CONVOLUTION")
+    kw.setdefault("n_in", 1)
+    kw.setdefault("n_out", 6)
+    kw.setdefault("filter_size", (5, 5))
+    kw.setdefault("activation_function", "relu")
+    return NeuralNetConfiguration(**kw)
+
+
+def test_conv_output_shape():
+    conf = conv_conf()
+    params = init_layer_params(jax.random.PRNGKey(0), conf)
+    assert params["convweights"].shape == (6, 1, 5, 5)
+    x = jnp.zeros((4, 1, 28, 28))
+    out = convolution.forward(conf, params, x)
+    assert out.shape == (4, 6, 24, 24)  # VALID 5x5 conv
+
+
+def test_subsampling_max_pool():
+    conf = NeuralNetConfiguration(layer_type="SUBSAMPLING", stride=(2, 2),
+                                  convolution_type=ConvolutionType.MAX)
+    x = jnp.arange(16.0).reshape(1, 1, 4, 4)
+    out = subsampling.forward(conf, {}, x)
+    np.testing.assert_allclose(np.asarray(out[0, 0]), [[5, 7], [13, 15]])
+
+
+def test_subsampling_avg_pool():
+    conf = NeuralNetConfiguration(layer_type="SUBSAMPLING", stride=(2, 2),
+                                  convolution_type=ConvolutionType.AVG)
+    x = jnp.ones((1, 1, 4, 4))
+    out = subsampling.forward(conf, {}, x)
+    np.testing.assert_allclose(np.asarray(out), np.ones((1, 1, 2, 2)))
+
+
+def test_lenet_trains_on_synthetic_mnist():
+    """BASELINE config #2 smoke: score decreases and accuracy beats chance."""
+    from deeplearning4j_tpu.datasets.fetchers import synthetic_mnist
+    from deeplearning4j_tpu.models.zoo import lenet
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    xs, ys = synthetic_mnist(256)
+    labels = np.eye(10, dtype=np.float32)[ys]
+    net = MultiLayerNetwork(lenet(num_iterations=1)).init()
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    data = DataSet(xs, labels)
+    before = net.score(data)
+    net.fit_epochs(data, num_epochs=30, batch_size=256)
+    after = net.score(data)
+    assert after < before * 0.6, (before, after)
+    acc = (net.predict(xs) == ys).mean()
+    assert acc > 0.5, acc
+
+
+# ------------------------------------------------------------------ RBM ----
+
+def rbm_conf(**kw):
+    kw.setdefault("layer_type", "RBM")
+    kw.setdefault("n_in", 6)
+    kw.setdefault("n_out", 4)
+    kw.setdefault("lr", 0.1)
+    kw.setdefault("k", 1)
+    return NeuralNetConfiguration(**kw)
+
+
+def test_rbm_prop_up_down_shapes():
+    conf = rbm_conf()
+    params = init_layer_params(jax.random.PRNGKey(0), conf)
+    v = jnp.ones((3, 6))
+    h = rbm.prop_up(conf, params, v)
+    assert h.shape == (3, 4)
+    v2 = rbm.prop_down(conf, params, h)
+    assert v2.shape == (3, 6)
+    assert float(h.min()) >= 0.0 and float(h.max()) <= 1.0  # binary units
+
+
+@pytest.mark.parametrize("hidden", [HiddenUnit.BINARY, HiddenUnit.RECTIFIED,
+                                    HiddenUnit.GAUSSIAN, HiddenUnit.SOFTMAX])
+def test_rbm_hidden_unit_types(hidden):
+    conf = rbm_conf(hidden_unit=hidden)
+    params = init_layer_params(jax.random.PRNGKey(0), conf)
+    mean, sample = rbm.sample_hidden_given_visible(
+        conf, params, jnp.ones((2, 6)), jax.random.PRNGKey(1)
+    )
+    assert mean.shape == sample.shape == (2, 4)
+    assert np.isfinite(np.asarray(sample)).all()
+
+
+@pytest.mark.parametrize("visible", [VisibleUnit.BINARY, VisibleUnit.GAUSSIAN,
+                                     VisibleUnit.LINEAR, VisibleUnit.SOFTMAX])
+def test_rbm_visible_unit_types(visible):
+    conf = rbm_conf(visible_unit=visible)
+    params = init_layer_params(jax.random.PRNGKey(0), conf)
+    mean, sample = rbm.sample_visible_given_hidden(
+        conf, params, jnp.ones((2, 4)), jax.random.PRNGKey(1)
+    )
+    assert mean.shape == sample.shape == (2, 6)
+
+
+def test_rbm_cd_learns_patterns():
+    """CD-k lowers reconstruction error on a small binary pattern set
+    (ref test model: RBMTests.testBasic)."""
+    rng = np.random.default_rng(0)
+    base = np.array([[1, 1, 1, 0, 0, 0], [0, 0, 0, 1, 1, 1]], np.float32)
+    x = jnp.asarray(np.repeat(base, 10, axis=0))
+    conf = rbm_conf(lr=0.5, k=1, num_iterations=150, use_ada_grad=False, momentum=0.0)
+    params = init_layer_params(jax.random.PRNGKey(0), conf)
+
+    before = float(rbm.reconstruction_error(conf, params, x))
+
+    def score_fn(p, key):
+        return rbm.reconstruction_error(conf, p, x)
+
+    def grad_fn(p, key):
+        return rbm.contrastive_divergence(conf, p, x, key)
+
+    solver = Solver(conf, score_fn, grad_fn=grad_fn)
+    from deeplearning4j_tpu.nn.api import OptimizationAlgorithm
+    params = solver.optimize(params, jax.random.PRNGKey(2),
+                             algo=OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT)
+    after = float(rbm.reconstruction_error(conf, params, x))
+    assert after < before * 0.7, (before, after)
+
+
+def test_rbm_cd_k_multiple_gibbs_steps():
+    conf = rbm_conf(k=3)
+    params = init_layer_params(jax.random.PRNGKey(0), conf)
+    g = rbm.contrastive_divergence(conf, params, jnp.ones((4, 6)), jax.random.PRNGKey(1))
+    assert set(g) == {"W", "b", "vb"}
+    assert g["W"].shape == (6, 4)
+
+
+# ----------------------------------------------------------- AutoEncoder ----
+
+def test_autoencoder_denoising_learns():
+    """ref test model: AutoEncoderTest — reconstruction improves."""
+    rng = np.random.default_rng(1)
+    x = jnp.asarray((rng.random((40, 12)) > 0.5).astype(np.float32))
+    conf = NeuralNetConfiguration(layer_type="AUTOENCODER", n_in=12, n_out=6,
+                                  lr=0.5, corruption_level=0.3,
+                                  num_iterations=200, use_ada_grad=True,
+                                  activation_function="sigmoid")
+    params = init_layer_params(jax.random.PRNGKey(0), conf)
+
+    def recon_err(p):
+        recon = ae.decode(conf, p, ae.encode(conf, p, x))
+        return float(jnp.mean((x - recon) ** 2))
+
+    before = recon_err(params)
+
+    def score_fn(p, key):
+        return ae.pretrain_loss(conf, p, x, key)
+
+    solver = Solver(conf, score_fn)
+    params = solver.optimize(params, jax.random.PRNGKey(3))
+    after = recon_err(params)
+    assert after < before * 0.8, (before, after)
+
+
+def test_corruption_masks_fraction():
+    x = jnp.ones((1000, 10))
+    corrupted = ae.get_corrupted_input(jax.random.PRNGKey(0), x, 0.3)
+    frac = float(corrupted.mean())
+    assert 0.65 < frac < 0.75  # ~70% kept
+
+
+# ------------------------------------------------------------------ LSTM ----
+
+def test_lstm_shapes_and_scan():
+    conf = NeuralNetConfiguration(layer_type="LSTM", n_in=10, n_out=16)
+    params = init_layer_params(jax.random.PRNGKey(0), conf)
+    assert params["recurrentweights"].shape == (1 + 10 + 16, 64)
+    x = jnp.zeros((2, 7, 10))  # (batch, time, features)
+    out = lstm.forward(conf, params, x)
+    assert out.shape == (2, 7, 16)
+
+
+def test_lstm_learns_echo():
+    """Predict the previous input token (1-step memory)."""
+    rng = np.random.default_rng(0)
+    vocab = 8
+    seq = rng.integers(0, vocab, size=(16, 20))
+    x = np.eye(vocab, dtype=np.float32)[seq]
+    # target: previous timestep's input
+    y = np.concatenate([np.zeros_like(x[:, :1]), x[:, :-1]], axis=1)
+
+    conf = NeuralNetConfiguration(layer_type="LSTM", n_in=vocab, n_out=vocab,
+                                  lr=0.05, num_iterations=150,
+                                  use_ada_grad=True, momentum=0.0)
+    params = init_layer_params(jax.random.PRNGKey(0), conf)
+    xj, yj = jnp.asarray(x), jnp.asarray(y)
+
+    def score_fn(p, key):
+        logits = lstm.forward(conf, p, xj)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.sum(yj * logp, axis=-1))
+
+    solver = Solver(conf, score_fn)
+    before = float(score_fn(params, None))
+    params = solver.optimize(params, jax.random.PRNGKey(1))
+    after = float(score_fn(params, None))
+    assert after < before * 0.6, (before, after)

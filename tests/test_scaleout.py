@@ -1,0 +1,136 @@
+"""Distributed control-plane tests — the whole cluster in one process
+(ref test model: TestDistributed / BaseTestDistributed in-JVM harness,
+SURVEY.md §4)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.impl import IrisDataSetIterator
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.scaleout import InMemoryStateTracker, LocalDistributedRunner
+from deeplearning4j_tpu.scaleout.aggregator import ParameterAveragingAggregator
+from deeplearning4j_tpu.scaleout.job import CollectionJobIterator, DataSetJobIterator, Job
+from deeplearning4j_tpu.scaleout.perform import MultiLayerNetworkWorkPerformer
+from deeplearning4j_tpu.scaleout.workrouter import (
+    HogWildWorkRouter,
+    IterativeReduceWorkRouter,
+)
+
+
+def iris_conf_json(num_iterations=20):
+    return (
+        NeuralNetConfiguration.Builder()
+        .n_in(4).n_out(8).activation_function("tanh")
+        .lr(0.1).momentum(0.9).num_iterations(num_iterations).seed(42)
+        .list(2)
+        .override(1, layer_type="OUTPUT", n_in=8, n_out=3,
+                  activation_function="softmax", loss_function="MCXENT")
+        .pretrain(False).backward(True)
+        .build()
+        .to_json()
+    )
+
+
+def test_aggregator_averages():
+    agg = ParameterAveragingAggregator()
+    j1, j2 = Job(None), Job(None)
+    j1.result = np.array([1.0, 2.0])
+    j2.result = np.array([3.0, 4.0])
+    agg.accumulate(j1)
+    agg.accumulate(j2)
+    np.testing.assert_allclose(agg.aggregate(), [2.0, 3.0])
+
+
+def test_state_tracker_round_trip():
+    t = InMemoryStateTracker()
+    t.add_worker("w0")
+    t.add_worker("w1")
+    assert t.workers() == ["w0", "w1"]
+    job = Job("work", "w0")
+    t.add_job(job)
+    assert t.job_for("w0") is job
+    t.add_update("w0", job)
+    assert "w0" in t.updates()
+    t.set_current(np.zeros(3))
+    t.add_replicate("w1")
+    assert t.needs_replicate("w1") and not t.needs_replicate("w0")
+    t.increment("n")
+    assert t.count("n") == 1.0
+    t.finish()
+    assert t.is_done()
+
+
+def test_routers_policy():
+    t = InMemoryStateTracker()
+    agg = ParameterAveragingAggregator()
+    t.add_worker("w0")
+    t.add_worker("w1")
+    sync = IterativeReduceWorkRouter(t, agg)
+    hog = HogWildWorkRouter(t, agg)
+    assert not sync.send_work()  # no updates yet
+    assert hog.send_work()       # always
+    j = Job("x", "w0")
+    j.result = np.ones(2)
+    t.add_update("w0", j)
+    assert not sync.send_work()  # only 1 of 2
+    j2 = Job("x", "w1")
+    j2.result = np.ones(2) * 3
+    t.add_update("w1", j2)
+    assert sync.send_work()
+    sync.update()
+    np.testing.assert_allclose(t.get_current(), [2.0, 2.0])
+    assert t.needs_replicate("w0") and t.needs_replicate("w1")
+    assert t.updates() == {}
+
+
+def test_local_distributed_training_converges():
+    """4 workers, IterativeReduce param averaging over Iris mini-batches —
+    the in-process analogue of the reference's TestDistributed."""
+    conf_json = iris_conf_json(num_iterations=15)
+    it = IrisDataSetIterator(25, 150)  # 6 mini-batch jobs
+    runner = LocalDistributedRunner(
+        performer_factory=lambda: MultiLayerNetworkWorkPerformer(conf_json),
+        job_iterator=DataSetJobIterator(it),
+        num_workers=4,
+    )
+    final_params = runner.train()
+    assert final_params is not None
+    assert runner.tracker.count("jobs_done") == 6
+
+    net = MultiLayerNetwork.from_json(conf_json)
+    net.init()
+    net.set_params(final_params)
+    full = IrisDataSetIterator(150, 150).next()
+    acc = (net.predict(full.features) == full.labels.argmax(-1)).mean()
+    assert acc > 0.6, acc
+
+
+def test_hogwild_router_runs():
+    conf_json = iris_conf_json(num_iterations=5)
+    it = IrisDataSetIterator(50, 150)
+    tracker = InMemoryStateTracker()
+    runner = LocalDistributedRunner(
+        performer_factory=lambda: MultiLayerNetworkWorkPerformer(conf_json),
+        job_iterator=DataSetJobIterator(it),
+        num_workers=2,
+        tracker=tracker,
+        router=HogWildWorkRouter(tracker, ParameterAveragingAggregator()),
+    )
+    assert runner.train() is not None
+
+
+def test_collection_job_iterator():
+    it = CollectionJobIterator([1, 2, 3])
+    seen = []
+    while it.has_next():
+        seen.append(it.next("w").work)
+    assert seen == [1, 2, 3]
+    it.reset()
+    assert it.has_next()
+
+
+def test_parallelization_map():
+    from deeplearning4j_tpu.scaleout.parallelization import iterate, run_in_parallel
+
+    assert iterate([1, 2, 3], lambda x: x * 2) == [2, 4, 6]
+    assert run_in_parallel([lambda: 1, lambda: 2]) == [1, 2]

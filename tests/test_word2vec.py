@@ -1,0 +1,130 @@
+"""Word2Vec + text pipeline tests (ref test model: Word2VecTests,
+TokenizerFactory tests, Huffman usage in Word2Vec.fit)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.embeddings import (
+    load_word_vectors,
+    write_word_vectors,
+)
+from deeplearning4j_tpu.models.word2vec import Word2Vec
+from deeplearning4j_tpu.text.sentence_iterator import CollectionSentenceIterator
+from deeplearning4j_tpu.text.tokenization import (
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+    NGramTokenizerFactory,
+)
+from deeplearning4j_tpu.text.vocab import VocabCache, build_huffman
+
+
+def test_default_tokenizer():
+    t = DefaultTokenizerFactory().create("To be or not to be")
+    assert t.get_tokens() == ["To", "be", "or", "not", "to", "be"]
+    assert t.count_tokens() == 6
+    assert t.has_more_tokens()
+    assert t.next_token() == "To"
+
+
+def test_tokenizer_preprocessor():
+    t = DefaultTokenizerFactory(CommonPreprocessor()).create("Hello, World!")
+    assert t.get_tokens() == ["hello", "world"]
+
+
+def test_ngram_tokenizer():
+    t = NGramTokenizerFactory(DefaultTokenizerFactory(), 1, 2).create("a b c")
+    assert t.get_tokens() == ["a", "b", "c", "a b", "b c"]
+
+
+def test_vocab_ordering_and_pruning():
+    v = VocabCache()
+    for w in ["a"] * 5 + ["b"] * 3 + ["c"]:
+        v.add_token(w)
+    v.finish(min_word_frequency=2)
+    assert v.num_words() == 2
+    assert v.word_at(0) == "a"  # most frequent first
+    assert v.index_of("c") == -1
+
+
+def test_huffman_codes_prefix_free():
+    v = VocabCache()
+    for w, n in [("a", 40), ("b", 30), ("c", 20), ("d", 10)]:
+        for _ in range(n):
+            v.add_token(w)
+    v.finish()
+    build_huffman(v)
+    codes = {w.word: "".join(map(str, w.code)) for w in v.words()}
+    # prefix-free property
+    for w1, c1 in codes.items():
+        for w2, c2 in codes.items():
+            if w1 != w2:
+                assert not c2.startswith(c1), codes
+    # frequent words get shorter codes
+    assert len(codes["a"]) <= len(codes["d"])
+    # points index into syn1 (inner nodes): all < n-1
+    for w in v.words():
+        assert all(0 <= p < v.num_words() - 1 for p in w.points)
+        assert len(w.points) == len(w.code)
+
+
+def _toy_corpus():
+    # two topic clusters: fruit words co-occur, machine words co-occur
+    fruit = "apple banana cherry fruit sweet juice"
+    tech = "cpu gpu chip silicon compute memory"
+    sents = []
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        words = rng.permutation(fruit.split()).tolist()
+        sents.append(" ".join(words))
+        words = rng.permutation(tech.split()).tolist()
+        sents.append(" ".join(words))
+    return sents
+
+
+def test_word2vec_sgns_learns_topics():
+    vec = Word2Vec(
+        sentence_iterator=CollectionSentenceIterator(_toy_corpus()),
+        layer_size=16, window=3, negative=5, iterations=2,
+        lr=0.05, sample=0, batch_size=512, seed=1,
+    )
+    vec.fit()
+    assert vec.has_word("apple")
+    same = vec.similarity("apple", "banana")
+    cross = vec.similarity("apple", "gpu")
+    assert same > cross, (same, cross)
+    nearest = vec.words_nearest("cpu", 5)
+    tech_words = {"gpu", "chip", "silicon", "compute", "memory"}
+    assert len(tech_words & set(nearest)) >= 3, nearest
+
+
+def test_word2vec_hierarchical_softmax_learns():
+    vec = Word2Vec(
+        sentence_iterator=CollectionSentenceIterator(_toy_corpus()),
+        layer_size=16, window=3, negative=0, use_hierarchic_softmax=True,
+        iterations=2, lr=0.05, sample=0, batch_size=512, seed=1,
+    )
+    vec.fit()
+    assert vec.similarity("banana", "cherry") > vec.similarity("banana", "chip")
+
+
+def test_serializer_round_trip(tmp_path):
+    vec = Word2Vec(
+        sentence_iterator=CollectionSentenceIterator(["a b c", "b c d"] * 5),
+        layer_size=8, negative=2, iterations=1, sample=0, batch_size=64,
+    )
+    vec.fit()
+    path = str(tmp_path / "vecs.txt")
+    write_word_vectors(vec.lookup_table, path)
+    vocab, mat = load_word_vectors(path)
+    assert vocab.num_words() == vec.vocab.num_words()
+    for w in vec.vocab.words():
+        np.testing.assert_allclose(
+            mat[vocab.index_of(w.word)],
+            vec.lookup_table.syn0[w.index],
+            atol=1e-5,
+        )
+
+
+def test_word2vec_requires_objective():
+    with pytest.raises(ValueError):
+        Word2Vec(negative=0, use_hierarchic_softmax=False)
