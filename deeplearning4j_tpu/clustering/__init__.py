@@ -1,0 +1,26 @@
+"""Clustering: k-means on device, space-partition trees on host.
+
+Parity with ref deeplearning4j-core clustering/ (KMeansClustering,
+BaseClusteringAlgorithm strategies/conditions, KDTree, VPTree, QuadTree,
+SpTree). The trees are host-side data structures in the reference too; the
+distance-heavy k-means assignment step runs on the TPU as one batched
+matmul-shaped kernel instead of per-point Java loops.
+"""
+
+from deeplearning4j_tpu.clustering.cluster import Cluster, ClusterSet, Point
+from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
+from deeplearning4j_tpu.clustering.kdtree import KDTree
+from deeplearning4j_tpu.clustering.vptree import VPTree
+from deeplearning4j_tpu.clustering.quadtree import QuadTree
+from deeplearning4j_tpu.clustering.sptree import SpTree
+
+__all__ = [
+    "Cluster",
+    "ClusterSet",
+    "Point",
+    "KMeansClustering",
+    "KDTree",
+    "VPTree",
+    "QuadTree",
+    "SpTree",
+]

@@ -1,0 +1,151 @@
+"""K-means clustering, TPU-shaped.
+
+Parity with ref clustering/kmeans/KMeansClustering.java:31 (setup with fixed
+iteration count or min distribution-variation rate, euclidean/cosine distance)
+over clustering/algorithm/BaseClusteringAlgorithm.java (init random centers →
+iterate: assign points, recompute centers, check condition).
+
+TPU-first: the reference assigns each point in a Java loop over clusters; here
+one Lloyd iteration is a single jitted function — an (N,K) distance matrix
+(‖x‖² − 2x·cᵀ + ‖c‖², i.e. MXU work) followed by segment-sum center updates.
+The convergence loop stays on host so the ConvergenceCondition /
+FixedIterationCountCondition semantics match the reference exactly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.clustering.cluster import Cluster, ClusterSet, Point
+
+
+@partial(jax.jit, static_argnames=("distance",))
+def _assign(points: jax.Array, centers: jax.Array, distance: str) -> jax.Array:
+    """(N,K) nearest-center assignment in one shot."""
+    if distance == "cosine":
+        pn = points / (jnp.linalg.norm(points, axis=1, keepdims=True) + 1e-12)
+        cn = centers / (jnp.linalg.norm(centers, axis=1, keepdims=True) + 1e-12)
+        sim = pn @ cn.T
+        return jnp.argmax(sim, axis=1)
+    # euclidean / manhattan: squared-euclidean is matmul-shaped and argmin-equal
+    if distance == "manhattan":
+        d = jnp.abs(points[:, None, :] - centers[None, :, :]).sum(-1)
+        return jnp.argmin(d, axis=1)
+    sq = (
+        (points * points).sum(1, keepdims=True)
+        - 2.0 * points @ centers.T
+        + (centers * centers).sum(1)[None, :]
+    )
+    return jnp.argmin(sq, axis=1)
+
+
+@partial(jax.jit, static_argnames=("k", "distance"))
+def _lloyd_step(points: jax.Array, centers: jax.Array, k: int, distance: str):
+    """One Lloyd iteration: assign + segment-sum recompute; empty clusters
+    keep their previous center (ref keeps stale centers too)."""
+    assign = _assign(points, centers, distance)
+    one_hot = jax.nn.one_hot(assign, k, dtype=points.dtype)  # (N,K)
+    counts = one_hot.sum(0)  # (K,)
+    sums = one_hot.T @ points  # (K,D) — MXU
+    new_centers = jnp.where(
+        counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), centers
+    )
+    # cost = mean squared distance to the assigned center
+    diffs = points - new_centers[assign]
+    cost = (diffs * diffs).sum(-1).mean()
+    return new_centers, assign, counts, cost
+
+
+class KMeansClustering:
+    """K-means with the reference's two stopping modes.
+
+    ``setup(k, max_iterations, distance)`` — fixed iteration count
+    (ref KMeansClustering.java:43); ``setup_convergence(k, rate, distance)``
+    — stop when the relative cost improvement falls below ``rate``
+    (ref :49, VarianceVariationCondition).
+    """
+
+    def __init__(
+        self,
+        k: int,
+        max_iterations: int = 100,
+        distance: str = "euclidean",
+        min_variation_rate: Optional[float] = None,
+        seed: int = 123,
+    ):
+        if distance not in ("euclidean", "cosine", "manhattan"):
+            raise ValueError(f"unknown distance {distance!r}")
+        self.k = k
+        self.max_iterations = max_iterations
+        self.distance = distance
+        self.min_variation_rate = min_variation_rate
+        self.seed = seed
+        self.iteration_costs: List[float] = []
+
+    @classmethod
+    def setup(cls, k: int, max_iterations: int, distance: str = "euclidean",
+              seed: int = 123) -> "KMeansClustering":
+        return cls(k, max_iterations=max_iterations, distance=distance, seed=seed)
+
+    @classmethod
+    def setup_convergence(cls, k: int, min_variation_rate: float,
+                          distance: str = "euclidean", max_iterations: int = 1000,
+                          seed: int = 123) -> "KMeansClustering":
+        return cls(k, max_iterations=max_iterations, distance=distance,
+                   min_variation_rate=min_variation_rate, seed=seed)
+
+    def _kpp_init(self, data: np.ndarray) -> np.ndarray:
+        """k-means++ seeding (D² sampling) — avoids the empty/merged-cluster
+        failures of the reference's sample-k-random-points init."""
+        rng = np.random.RandomState(self.seed)
+        n = data.shape[0]
+        centers = [data[rng.randint(n)]]
+        for _ in range(1, self.k):
+            d2 = np.min(
+                [((data - c) ** 2).sum(1) for c in centers], axis=0
+            )
+            probs = d2 / max(d2.sum(), 1e-12)
+            centers.append(data[rng.choice(n, p=probs)])
+        return np.stack(centers)
+
+    def apply_to(self, points) -> ClusterSet:
+        """Run clustering; accepts an (N,D) array or a list of Points."""
+        if isinstance(points, (list, tuple)):
+            point_objs = list(points)
+            data = np.stack([p.array for p in point_objs])
+        else:
+            data = np.asarray(points, dtype=np.float32)
+            point_objs = Point.to_points(data)
+        n = data.shape[0]
+        if n < self.k:
+            raise ValueError(f"need at least k={self.k} points, got {n}")
+
+        x = jnp.asarray(data, jnp.float32)
+        centers = jnp.asarray(self._kpp_init(data), jnp.float32)
+
+        self.iteration_costs = []
+        prev_cost = None
+        assign = None
+        for _ in range(self.max_iterations):
+            centers, assign, _counts, cost = _lloyd_step(
+                x, centers, self.k, self.distance
+            )
+            cost = float(cost)
+            self.iteration_costs.append(cost)
+            if prev_cost is not None and self.min_variation_rate is not None:
+                variation = abs(prev_cost - cost) / max(abs(prev_cost), 1e-12)
+                if variation < self.min_variation_rate:
+                    break
+            prev_cost = cost
+
+        centers_np = np.asarray(centers)
+        assign_np = np.asarray(assign)
+        clusters = [Cluster(center=centers_np[i]) for i in range(self.k)]
+        for idx, p in zip(assign_np, point_objs):
+            clusters[int(idx)].add_point(p)
+        return ClusterSet(clusters=clusters)

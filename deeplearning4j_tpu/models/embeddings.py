@@ -8,16 +8,14 @@ text format round-trip).
 
 from __future__ import annotations
 
-import io
-from typing import Dict, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
 
-from deeplearning4j_tpu.text.vocab import VocabCache, VocabWord, build_huffman
+from deeplearning4j_tpu.text.vocab import VocabCache, VocabWord
 
 UNIGRAM_TABLE_SIZE = 1 << 20
 UNIGRAM_POWER = 0.75
-
 
 class InMemoryLookupTable:
     """Host-resident master copy of the embedding matrices; device copies are
@@ -31,10 +29,14 @@ class InMemoryLookupTable:
         self.negative = negative
         rng = np.random.default_rng(seed)
         n = vocab.num_words()
-        # ref resetWeights: syn0 ~ U(-0.5,0.5)/layerSize, syn1 zeros
+        # ref resetWeights: syn0 ~ U(-0.5,0.5)/layerSize, syn1 zeros.
+        # Only the matrices the chosen objective needs are allocated (a
+        # 1M-word vocab at D=300 would waste ~1.2 GB otherwise).
         self.syn0 = ((rng.random((n, layer_size)) - 0.5) / layer_size).astype(np.float32)
-        self.syn1 = np.zeros((max(n - 1, 1), layer_size), dtype=np.float32)
-        self.syn1neg = np.zeros((n, layer_size), dtype=np.float32)
+        self.syn1 = (np.zeros((max(n - 1, 1), layer_size), dtype=np.float32)
+                     if use_hs else np.zeros((1, layer_size), dtype=np.float32))
+        self.syn1neg = (np.zeros((n, layer_size), dtype=np.float32)
+                        if negative > 0 else np.zeros((1, layer_size), dtype=np.float32))
         self._unigram: Optional[np.ndarray] = None
 
     def unigram_probs(self) -> np.ndarray:
@@ -48,7 +50,6 @@ class InMemoryLookupTable:
         idx = self.vocab.index_of(word)
         return None if idx < 0 else self.syn0[idx]
 
-
 # ------------------------------------------------------------ serializer ----
 
 def write_word_vectors(table: InMemoryLookupTable, path: str) -> None:
@@ -61,7 +62,6 @@ def write_word_vectors(table: InMemoryLookupTable, path: str) -> None:
             vec = " ".join(f"{x:.6f}" for x in table.syn0[i])
             f.write(f"{table.vocab.word_at(i)} {vec}\n")
 
-
 def load_word_vectors(path: str) -> Tuple[VocabCache, np.ndarray]:
     """(ref: WordVectorSerializer.loadTxtVectors). Vocab indices follow file
     order (which write_word_vectors emits in index order)."""
@@ -71,11 +71,14 @@ def load_word_vectors(path: str) -> Tuple[VocabCache, np.ndarray]:
         header = f.readline().split()
         n, d = int(header[0]), int(header[1])
         for i, line in enumerate(f):
+            # split from the right: the last d tokens are floats, the rest is
+            # the word (which may itself contain spaces, e.g. n-gram tokens)
             parts = line.rstrip().split(" ")
-            vw = VocabWord(parts[0], count=1, index=i)
+            word = " ".join(parts[: len(parts) - d])
+            vw = VocabWord(word, count=1, index=i)
             vocab._words[vw.word] = vw
             vocab._index.append(vw)
-            vecs.append(np.array([float(x) for x in parts[1 : d + 1]], np.float32))
+            vecs.append(np.array([float(x) for x in parts[len(parts) - d:]], np.float32))
     mat = np.stack(vecs) if vecs else np.zeros((0, d), np.float32)
     assert mat.shape == (n, d), f"header {(n, d)} vs data {mat.shape}"
     return vocab, mat

@@ -22,7 +22,6 @@ the same semantics as the reference's racy Hogwild updates.
 
 from __future__ import annotations
 
-import math
 from functools import partial
 from typing import List, Optional, Sequence, Tuple
 
@@ -59,10 +58,20 @@ def _sgns_step(syn0, syn1neg, centers, contexts, weights, probs_logits, lr, key,
     grad_u_pos = g_pos[:, None] * v
     grad_u_neg = g_neg[..., None] * v[:, None, :]
 
-    syn0 = syn0.at[centers].add(-lr * grad_v)
-    syn1neg = syn1neg.at[contexts].add(-lr * grad_u_pos)
-    syn1neg = syn1neg.at[negs.reshape(-1)].add(
-        -lr * grad_u_neg.reshape(-1, grad_u_neg.shape[-1])
+    # Normalize each row's accumulated update by its collision count in the
+    # batch: duplicate indices would otherwise SUM hundreds of same-row
+    # gradients computed at stale values (the reference applies them
+    # sequentially), which diverges on small vocabularies.
+    c_cnt = jnp.zeros(syn0.shape[0], syn0.dtype).at[centers].add(weights)
+    syn0 = syn0.at[centers].add(-lr * grad_v / jnp.maximum(c_cnt, 1.0)[centers, None])
+    u_idx = jnp.concatenate([contexts, negs.reshape(-1)])
+    u_grad = jnp.concatenate(
+        [grad_u_pos, grad_u_neg.reshape(-1, grad_u_neg.shape[-1])]
+    )
+    u_w = jnp.concatenate([weights, jnp.repeat(weights, negative)])
+    u_cnt = jnp.zeros(syn1neg.shape[0], syn0.dtype).at[u_idx].add(u_w)
+    syn1neg = syn1neg.at[u_idx].add(
+        -lr * u_grad / jnp.maximum(u_cnt, 1.0)[u_idx, None]
     )
     eps = 1e-7
     loss = -(jnp.log(pos_score + eps) * weights).sum() - (
@@ -84,8 +93,16 @@ def _hs_step(syn0, syn1, centers, points, codes, mask, weights, lr):
     grad_v = jnp.einsum("bl,bld->bd", g, u)
     grad_u = g[..., None] * v[:, None, :]
 
-    syn0 = syn0.at[centers].add(-lr * grad_v)
-    syn1 = syn1.at[points.reshape(-1)].add(-lr * grad_u.reshape(-1, grad_u.shape[-1]))
+    # per-row collision normalization (see _sgns_step)
+    c_cnt = jnp.zeros(syn0.shape[0], syn0.dtype).at[centers].add(weights)
+    syn0 = syn0.at[centers].add(-lr * grad_v / jnp.maximum(c_cnt, 1.0)[centers, None])
+    p_idx = points.reshape(-1)
+    p_msk = mask.reshape(-1)
+    p_cnt = jnp.zeros(syn1.shape[0], syn0.dtype).at[p_idx].add(p_msk)
+    syn1 = syn1.at[p_idx].add(
+        -lr * grad_u.reshape(-1, grad_u.shape[-1])
+        / jnp.maximum(p_cnt, 1.0)[p_idx, None]
+    )
     eps = 1e-7
     loss = -jnp.sum(
         (labels * jnp.log(score + eps) + (1 - labels) * jnp.log(1 - score + eps))
@@ -215,8 +232,8 @@ class Word2Vec:
                 msk[w.index, :path_len] = 1.0
             pts_j, cds_j, msk_j = jnp.asarray(pts), jnp.asarray(cds), jnp.asarray(msk)
 
-        total_words = self.vocab.total_word_count() * max(self.iterations, 1)
-        words_seen = 0
+        total_pairs = None  # set from the first epoch's pair count so the
+        pairs_seen = 0      # linear decay spans the whole run in PAIR units
         bsz = self.batch_size
 
         for _ in range(max(self.iterations, 1)):
@@ -224,6 +241,8 @@ class Word2Vec:
             rng.shuffle(sents)
             centers, contexts = self._skipgram_pairs(sents, rng)
             n_pairs = centers.shape[0]
+            if total_pairs is None:
+                total_pairs = max(n_pairs, 1) * max(self.iterations, 1)
             for start in range(0, n_pairs, bsz):
                 c = centers[start : start + bsz]
                 t = contexts[start : start + bsz]
@@ -233,8 +252,10 @@ class Word2Vec:
                     c = np.concatenate([c, np.zeros(pad, np.int32)])
                     t = np.concatenate([t, np.zeros(pad, np.int32)])
                     w = np.concatenate([w, np.zeros(pad, np.float32)])
-                # linear lr decay by words processed (ref: Word2Vec.java:85)
-                frac = min(words_seen / max(total_words, 1), 1.0)
+                # linear lr decay over training progress (ref decays by words
+                # processed, Word2Vec.java:85; here progress is measured in
+                # skip-gram pairs since that is the unit of device work)
+                frac = min(pairs_seen / max(total_pairs, 1), 1.0)
                 lr = max(self.min_lr, self.lr * (1.0 - frac))
                 cj, tj, wj = jnp.asarray(c), jnp.asarray(t), jnp.asarray(w)
                 if self.negative > 0:
@@ -248,11 +269,11 @@ class Word2Vec:
                         syn0, syn1, cj, pts_j[tj], cds_j[tj], msk_j[tj], wj,
                         jnp.float32(lr),
                     )
-                words_seen += int(w.sum())
+                pairs_seen += int(w.sum())
         table.syn0 = np.asarray(syn0)
         table.syn1 = np.asarray(syn1)
         table.syn1neg = np.asarray(syn1neg)
-        self.total_words_trained = words_seen
+        self.total_words_trained = pairs_seen
 
     # ---- query API (ref: WordVectors interface) ----
     def word_vector(self, word: str) -> Optional[np.ndarray]:

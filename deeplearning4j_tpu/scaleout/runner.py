@@ -99,10 +99,11 @@ class LocalDistributedRunner:
                 # master: aggregate when router policy allows
                 if self.router.send_work():
                     self.router.update()
+                    self.tracker.increment("aggregations")
                     if self.model_saver is not None:
                         current = self.tracker.get_current()
                         if current is not None:
-                            self.tracker.increment("aggregations")
+                            self.model_saver.save(current)
             # final aggregation of any straggler updates
             if self.tracker.updates():
                 self.router.update()

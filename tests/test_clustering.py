@@ -1,0 +1,171 @@
+"""Clustering tests — mirrors ref test strategy (tree invariants + small-data
+clustering assertions: KDTreeTest, VPTreeTest, QuadTreeTest, SPTreeTest,
+KMeansClustering usage in BarnesHutTsne)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (
+    KDTree,
+    KMeansClustering,
+    Point,
+    QuadTree,
+    SpTree,
+    VPTree,
+)
+
+
+def _blobs(n_per=30, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = np.array([[0.0, 0.0], [10.0, 10.0], [-10.0, 10.0]])
+    pts = np.concatenate(
+        [c + rng.randn(n_per, 2) for c in centers]
+    ).astype(np.float32)
+    labels = np.repeat(np.arange(3), n_per)
+    return pts, labels
+
+
+class TestKMeans:
+    def test_separable_blobs(self):
+        pts, labels = _blobs()
+        km = KMeansClustering.setup(3, max_iterations=50, seed=3)
+        cs = km.apply_to(pts)
+        assert len(cs.clusters) == 3
+        # each true blob maps to exactly one cluster
+        assign = np.array([np.argmin(np.linalg.norm(cs.centers - p, axis=1))
+                           for p in pts])
+        for lab in range(3):
+            assert len(set(assign[labels == lab])) == 1
+        # cost decreased monotonically-ish and converged
+        assert km.iteration_costs[-1] <= km.iteration_costs[0]
+
+    def test_convergence_mode_stops_early(self):
+        pts, _ = _blobs()
+        km = KMeansClustering.setup_convergence(3, 1e-4, max_iterations=500, seed=3)
+        km.apply_to(pts)
+        assert len(km.iteration_costs) < 500
+
+    def test_cosine_distance(self):
+        rng = np.random.RandomState(1)
+        a = rng.rand(20, 5) + np.array([10, 0, 0, 0, 0])
+        b = rng.rand(20, 5) + np.array([0, 10, 0, 0, 0])
+        km = KMeansClustering.setup(2, 20, distance="cosine")
+        cs = km.apply_to(np.concatenate([a, b]).astype(np.float32))
+        sizes = sorted(len(c.points) for c in cs.clusters)
+        assert sizes == [20, 20]
+
+    def test_classify_point(self):
+        pts, _ = _blobs()
+        km = KMeansClustering.setup(3, 30)
+        cs = km.apply_to(pts)
+        c = cs.classify_point(Point(np.array([10.0, 10.0])), add=False)
+        assert np.linalg.norm(c.center - [10, 10]) < 2.0
+
+
+class TestKDTree:
+    def test_nn_matches_bruteforce(self):
+        rng = np.random.RandomState(0)
+        data = rng.rand(200, 3)
+        tree = KDTree(3)
+        for row in data:
+            tree.insert(row)
+        assert tree.size == 200
+        for _ in range(20):
+            q = rng.rand(3)
+            p, d = tree.nn(q)
+            brute = np.linalg.norm(data - q, axis=1)
+            assert d == pytest.approx(brute.min())
+
+    def test_knn(self):
+        rng = np.random.RandomState(1)
+        data = rng.rand(100, 2)
+        tree = KDTree(2)
+        for row in data:
+            tree.insert(row)
+        q = np.array([0.5, 0.5])
+        res = tree.knn(q, 5)
+        brute = np.sort(np.linalg.norm(data - q, axis=1))[:5]
+        assert np.allclose([d for _, d in res], brute)
+
+    def test_range_search(self):
+        tree = KDTree(2)
+        grid = np.array([[i, j] for i in range(5) for j in range(5)], float)
+        for row in grid:
+            tree.insert(row)
+        found = tree.range_search([1, 1], [3, 3])
+        assert len(found) == 9
+
+
+class TestVPTree:
+    def test_knn_matches_bruteforce(self):
+        rng = np.random.RandomState(2)
+        data = rng.rand(150, 4)
+        tree = VPTree(data)
+        for _ in range(10):
+            q = rng.rand(4)
+            res = tree.search(q, 7)
+            brute_idx = np.argsort(np.linalg.norm(data - q, axis=1))[:7]
+            assert set(i for i, _ in res) == set(brute_idx.tolist())
+
+    def test_labels(self):
+        data = np.eye(4)
+        tree = VPTree(data, labels=["a", "b", "c", "d"])
+        res = tree.search(np.array([1.0, 0.1, 0, 0]), 1)
+        assert tree.word_for(res[0][0]) == "a"
+
+    def test_cosine(self):
+        data = np.array([[1, 0], [0, 1], [0.9, 0.1]], float)
+        tree = VPTree(data, similarity="cosine")
+        res = tree.search(np.array([1.0, 0.0]), 2)
+        assert set(i for i, _ in res) == {0, 2}
+
+
+class TestQuadTree:
+    def test_invariants(self):
+        rng = np.random.RandomState(3)
+        data = rng.randn(64, 2)
+        tree = QuadTree(data)
+        assert tree.is_correct()
+        assert tree.cum_size == 64
+        assert np.allclose(tree.center_of_mass, data.mean(0))
+
+    def test_non_edge_forces_nonzero(self):
+        rng = np.random.RandomState(4)
+        data = rng.randn(32, 2)
+        tree = QuadTree(data)
+        neg_f = np.zeros(2)
+        z = tree.compute_non_edge_forces(0, data[0], theta=0.5, neg_f=neg_f)
+        assert z > 0
+        assert np.linalg.norm(neg_f) > 0
+
+
+class TestSpTree:
+    def test_invariants_3d(self):
+        rng = np.random.RandomState(5)
+        data = rng.randn(50, 3)
+        tree = SpTree(data)
+        assert tree.is_correct()
+        assert tree.cum_size == 50
+        assert np.allclose(tree.center_of_mass, data.mean(0))
+
+    def test_theta_zero_matches_exact_repulsion(self):
+        # theta=0 → never approximate → matches brute-force t-SNE repulsion
+        rng = np.random.RandomState(6)
+        y = rng.randn(20, 2)
+        tree = SpTree(y)
+        i = 3
+        neg_f = np.zeros(2)
+        z = tree.compute_non_edge_forces(i, y[i], theta=0.0, neg_f=neg_f)
+        diff = y[i] - np.delete(y, i, axis=0)
+        q = 1.0 / (1.0 + (diff * diff).sum(1))
+        assert z == pytest.approx(q.sum(), rel=1e-9)
+        assert np.allclose(neg_f, (q[:, None] ** 2 * diff).sum(0))
+
+    def test_edge_forces(self):
+        y = np.array([[0.0, 0.0], [1.0, 0.0], [0.0, 1.0]])
+        rows = np.array([0, 2, 3, 4])
+        cols = np.array([1, 2, 0, 0])
+        vals = np.array([0.5, 0.5, 1.0, 1.0])
+        pos_f = SpTree.compute_edge_forces(rows, cols, vals, y)
+        assert pos_f.shape == (3, 2)
+        assert np.allclose(pos_f[0], 0.5 * (y[0] - y[1]) / 2 + 0.5 * (y[0] - y[2]) / 2)

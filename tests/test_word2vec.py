@@ -84,8 +84,8 @@ def _toy_corpus():
 def test_word2vec_sgns_learns_topics():
     vec = Word2Vec(
         sentence_iterator=CollectionSentenceIterator(_toy_corpus()),
-        layer_size=16, window=3, negative=5, iterations=2,
-        lr=0.05, sample=0, batch_size=512, seed=1,
+        layer_size=16, window=3, negative=5, iterations=10,
+        lr=0.1, sample=0, batch_size=128, seed=1,
     )
     vec.fit()
     assert vec.has_word("apple")
@@ -101,7 +101,7 @@ def test_word2vec_hierarchical_softmax_learns():
     vec = Word2Vec(
         sentence_iterator=CollectionSentenceIterator(_toy_corpus()),
         layer_size=16, window=3, negative=0, use_hierarchic_softmax=True,
-        iterations=2, lr=0.05, sample=0, batch_size=512, seed=1,
+        iterations=10, lr=0.1, sample=0, batch_size=128, seed=1,
     )
     vec.fit()
     assert vec.similarity("banana", "cherry") > vec.similarity("banana", "chip")
