@@ -82,3 +82,66 @@ def load_word_vectors(path: str) -> Tuple[VocabCache, np.ndarray]:
     mat = np.stack(vecs) if vecs else np.zeros((0, d), np.float32)
     assert mat.shape == (n, d), f"header {(n, d)} vs data {mat.shape}"
     return vocab, mat
+
+def write_word_vectors_binary(table: InMemoryLookupTable, path: str) -> None:
+    """Classic word2vec binary format: ascii header 'V D\\n', then per word
+    'word ' + D little-endian float32 + '\\n'
+    (ref: WordVectorSerializer binary path, loadGoogleModel)."""
+    n, d = table.syn0.shape
+    with open(path, "wb") as f:
+        f.write(f"{n} {d}\n".encode("utf-8"))
+        for i in range(n):
+            word = table.vocab.word_at(i)
+            if " " in word or "\n" in word:
+                raise ValueError(
+                    f"binary word2vec format cannot represent token {word!r} "
+                    "(contains whitespace); use write_word_vectors (text) instead"
+                )
+            f.write(word.encode("utf-8") + b" ")
+            f.write(table.syn0[i].astype("<f4").tobytes())
+            f.write(b"\n")
+
+def load_word_vectors_binary(path: str) -> Tuple[VocabCache, np.ndarray]:
+    """Load the word2vec binary format (ref: WordVectorSerializer.loadGoogleModel
+    with binary=true)."""
+    vocab = VocabCache()
+    with open(path, "rb") as f:
+        header = f.readline().decode("utf-8").split()
+        n, d = int(header[0]), int(header[1])
+        mat = np.empty((n, d), np.float32)
+        for i in range(n):
+            # skip any leading whitespace, then scan the word up to ' ' —
+            # tolerates files both with and without per-record newlines
+            # (gensim writes none)
+            chars = bytearray()
+            while True:
+                ch = f.read(1)
+                if ch == b"":
+                    break
+                if ch in (b"\n", b"\r", b" ") and not chars:
+                    continue
+                if ch == b" ":
+                    break
+                chars.extend(ch)
+            word = chars.decode("utf-8")
+            mat[i] = np.frombuffer(f.read(4 * d), dtype="<f4")
+            vw = VocabWord(word, count=1, index=i)
+            vocab._words[vw.word] = vw
+            vocab._index.append(vw)
+    return vocab, mat
+
+def cosine_nearest(matrix: np.ndarray, query: np.ndarray, n: int,
+                   exclude: int = -1) -> List[int]:
+    """Indices of the n rows of matrix most cosine-similar to query,
+    optionally excluding one row (the query's own index)."""
+    normed = matrix / np.maximum(np.linalg.norm(matrix, axis=1, keepdims=True), 1e-12)
+    sims = normed @ (query / max(np.linalg.norm(query), 1e-12))
+    if exclude >= 0:
+        sims[exclude] = -np.inf
+    return [int(i) for i in np.argsort(-sims)[:n]]
+
+def cosine_sim(v1: Optional[np.ndarray], v2: Optional[np.ndarray]) -> float:
+    if v1 is None or v2 is None:
+        return float("nan")
+    denom = np.linalg.norm(v1) * np.linalg.norm(v2)
+    return float(np.dot(v1, v2) / denom) if denom else 0.0

@@ -1,0 +1,127 @@
+"""ParagraphVectors (doc2vec, PV-DBOW).
+
+Parity with ref models/paragraphvectors/ParagraphVectors.java:55,167-204 —
+extends Word2Vec; after (optional) word training, dbow() trains one vector
+per document label to predict the words the document contains.
+
+TPU-first: the reference's dbow loop is sequential per (label, word); here
+(doc, word) pairs batch through the same jitted negative-sampling step as
+Word2Vec, with the doc-vector matrix standing in for syn0 (the word output
+embeddings syn1neg are shared with the word model and trained jointly during
+the doc phase, as the reference does; the updated matrix is written back to
+the lookup table after dbow).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.models.embeddings import cosine_nearest, cosine_sim
+from deeplearning4j_tpu.models.word2vec import Word2Vec, _sgns_step
+from deeplearning4j_tpu.text.sentence_iterator import CollectionSentenceIterator
+
+
+class ParagraphVectors(Word2Vec):
+    """PV-DBOW over labeled documents. ``documents`` is a sequence of
+    (label, text) pairs (ref LabelledDocument + LabelsSource)."""
+
+    def __init__(self, documents: Sequence[Tuple[str, str]],
+                 train_words: bool = True, **kwargs):
+        self.documents = list(documents)
+        self.train_words = train_words
+        self.labels: List[str] = [lab for lab, _ in self.documents]
+        self.doc_vectors: Optional[np.ndarray] = None
+        kwargs.setdefault("negative", 5)
+        super().__init__(
+            sentence_iterator=CollectionSentenceIterator(
+                [text for _, text in self.documents]
+            ),
+            **kwargs,
+        )
+        if not self.negative:
+            raise ValueError("PV-DBOW here requires negative sampling")
+
+    def fit(self) -> None:
+        if self.lookup_table is None:
+            self.build_vocab()
+        if self.train_words:
+            super().fit()  # skip-gram word phase (ref trainWordVectors flag)
+        self._dbow()
+
+    def _dbow(self) -> None:
+        """PV-DBOW: each document's vector predicts its words
+        (ref ParagraphVectors.dbow, :167-204)."""
+        rng = np.random.default_rng(self.seed + 7)
+        n_docs = len(self.documents)
+        d = self.layer_size
+        doc_vecs = jnp.asarray(
+            ((rng.random((n_docs, d)) - 0.5) / d).astype(np.float32)
+        )
+        syn1neg = jnp.asarray(self.lookup_table.syn1neg)
+        probs_logits = jnp.asarray(
+            np.log(self.lookup_table.unigram_probs() + 1e-12)
+        )
+
+        # (doc, word) pairs
+        docs_idx: List[int] = []
+        words_idx: List[int] = []
+        for di, (_, text) in enumerate(self.documents):
+            for tok in self.tokenizer_factory.create(text).get_tokens():
+                wi = self.vocab.index_of(tok)
+                if wi >= 0:
+                    docs_idx.append(di)
+                    words_idx.append(wi)
+        centers = np.asarray(docs_idx, np.int32)
+        contexts = np.asarray(words_idx, np.int32)
+        n_pairs = len(centers)
+        if n_pairs == 0:
+            self.doc_vectors = np.asarray(doc_vecs)
+            return
+        bsz = min(self.batch_size, n_pairs)
+
+        key = jax.random.PRNGKey(self.seed + 11)
+        total = n_pairs * max(self.iterations, 1)
+        seen = 0
+        for _ in range(max(self.iterations, 1)):
+            perm = rng.permutation(n_pairs)
+            for start in range(0, n_pairs, bsz):
+                sl = perm[start : start + bsz]
+                c, t = centers[sl], contexts[sl]
+                w = np.ones(len(sl), np.float32)
+                if len(sl) < bsz:
+                    pad = bsz - len(sl)
+                    c = np.concatenate([c, np.zeros(pad, np.int32)])
+                    t = np.concatenate([t, np.zeros(pad, np.int32)])
+                    w = np.concatenate([w, np.zeros(pad, np.float32)])
+                lr = max(self.min_lr, self.lr * (1.0 - seen / total))
+                key, sub = jax.random.split(key)
+                doc_vecs, syn1neg, _ = _sgns_step(
+                    doc_vecs, syn1neg, jnp.asarray(c), jnp.asarray(t),
+                    jnp.asarray(w), probs_logits, jnp.float32(lr), sub,
+                    self.negative,
+                )
+                seen += int(w.sum())
+        self.doc_vectors = np.asarray(doc_vecs)
+        self.lookup_table.syn1neg = np.asarray(syn1neg)
+
+    # ---- query API ----
+    def doc_vector(self, label: str) -> Optional[np.ndarray]:
+        try:
+            return self.doc_vectors[self.labels.index(label)]
+        except (ValueError, TypeError):
+            return None
+
+    def similarity_docs(self, l1: str, l2: str) -> float:
+        return cosine_sim(self.doc_vector(l1), self.doc_vector(l2))
+
+    def nearest_docs(self, label: str, n: int = 5) -> List[str]:
+        v = self.doc_vector(label)
+        if v is None:
+            return []
+        idx = cosine_nearest(self.doc_vectors, v, n,
+                             exclude=self.labels.index(label))
+        return [self.labels[i] for i in idx]

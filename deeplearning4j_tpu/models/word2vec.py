@@ -29,7 +29,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu.models.embeddings import InMemoryLookupTable
+from deeplearning4j_tpu.models.embeddings import (
+    InMemoryLookupTable,
+    cosine_nearest,
+    cosine_sim,
+)
 from deeplearning4j_tpu.text.sentence_iterator import SentenceIterator
 from deeplearning4j_tpu.text.tokenization import DefaultTokenizerFactory, TokenizerFactory
 from deeplearning4j_tpu.text.vocab import VocabCache, build_huffman
@@ -283,25 +287,12 @@ class Word2Vec:
         return self.vocab.contains(word)
 
     def similarity(self, w1: str, w2: str) -> float:
-        v1, v2 = self.word_vector(w1), self.word_vector(w2)
-        if v1 is None or v2 is None:
-            return float("nan")
-        denom = np.linalg.norm(v1) * np.linalg.norm(v2)
-        return float(np.dot(v1, v2) / denom) if denom else 0.0
+        return cosine_sim(self.word_vector(w1), self.word_vector(w2))
 
     def words_nearest(self, word: str, n: int = 10) -> List[str]:
         v = self.word_vector(word)
         if v is None:
             return []
-        syn0 = self.lookup_table.syn0
-        norms = np.linalg.norm(syn0, axis=1) * (np.linalg.norm(v) + 1e-12)
-        sims = syn0 @ v / np.maximum(norms, 1e-12)
-        order = np.argsort(-sims)
-        out = []
-        for i in order:
-            w = self.vocab.word_at(int(i))
-            if w != word:
-                out.append(w)
-            if len(out) >= n:
-                break
-        return out
+        idx = cosine_nearest(self.lookup_table.syn0, v, n,
+                             exclude=self.vocab.index_of(word))
+        return [self.vocab.word_at(i) for i in idx]

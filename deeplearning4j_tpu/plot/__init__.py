@@ -1,0 +1,14 @@
+"""Visualization: t-SNE (exact + Barnes-Hut) and artifact renderers.
+
+Parity with ref deeplearning4j-core plot/ — Tsne.java (exact t-SNE with
+perplexity-calibrated affinities, momentum + early exaggeration descent),
+BarnesHutTsne.java (SpTree-accelerated, implements the Model API), and
+NeuralNetPlotter/FilterRenderer (which shelled out to a python matplotlib
+script; here renderers write self-contained JSON/HTML artifacts instead).
+"""
+
+from deeplearning4j_tpu.plot.tsne import Tsne
+from deeplearning4j_tpu.plot.barnes_hut_tsne import BarnesHutTsne
+from deeplearning4j_tpu.plot.renderers import NeuralNetPlotter, FilterRenderer
+
+__all__ = ["Tsne", "BarnesHutTsne", "NeuralNetPlotter", "FilterRenderer"]

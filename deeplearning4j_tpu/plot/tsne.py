@@ -1,0 +1,170 @@
+"""Exact t-SNE, fully on device.
+
+Parity with ref plot/Tsne.java — d2p() perplexity calibration via per-point
+binary search, gradient() with the (P−Q) attractive/repulsive split, descent
+with momentum switch + early exaggeration (Tsne.java:272,:372-384).
+
+TPU-first: the reference computes row-by-row Java loops; here calibration is a
+vmapped fixed-iteration bisection and the whole descent is one
+``lax.fori_loop`` over jitted iterations — N×N kernels are matmul-shaped and
+map onto the MXU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def _pairwise_sq_dists(x: Array) -> Array:
+    sq = (x * x).sum(1)
+    d = sq[:, None] - 2.0 * x @ x.T + sq[None, :]
+    return jnp.maximum(d, 0.0)
+
+
+@partial(jax.jit, static_argnames=("tol_iters",))
+def _d2p(d: Array, perplexity: float, tol_iters: int = 50) -> Array:
+    """Row-stochastic affinities with per-row binary search on beta = 1/2σ²
+    so each row's entropy hits log(perplexity). Ref Tsne.java d2p()."""
+    n = d.shape[0]
+    log_u = jnp.log(perplexity)
+    eye = jnp.eye(n, dtype=bool)
+
+    def row_probs(drow, beta, i):
+        p = jnp.exp(-drow * beta)
+        p = jnp.where(jnp.arange(n) == i, 0.0, p)
+        psum = jnp.maximum(p.sum(), 1e-12)
+        h = jnp.log(psum) + beta * (drow * p).sum() / psum
+        return p / psum, h
+
+    def calibrate(drow, i):
+        def body(carry, _):
+            beta, lo, hi = carry
+            _, h = row_probs(drow, beta, i)
+            too_high = h > log_u  # entropy too high → increase beta
+            lo2 = jnp.where(too_high, beta, lo)
+            hi2 = jnp.where(too_high, hi, beta)
+            beta2 = jnp.where(
+                too_high,
+                jnp.where(jnp.isinf(hi2), beta * 2.0, (beta + hi2) / 2.0),
+                jnp.where(lo2 <= 0.0, beta / 2.0, (beta + lo2) / 2.0),
+            )
+            return (beta2, lo2, hi2), None
+
+        (beta, _, _), _ = jax.lax.scan(
+            body, (jnp.float32(1.0), jnp.float32(0.0), jnp.float32(jnp.inf)),
+            None, length=tol_iters,
+        )
+        p, _ = row_probs(drow, beta, i)
+        return p
+
+    p = jax.vmap(calibrate)(d, jnp.arange(n))
+    p = jnp.where(eye, 0.0, p)
+    # symmetrize (ref: p = p + pᵀ, normalized)
+    p = p + p.T
+    return jnp.maximum(p / jnp.maximum(p.sum(), 1e-12), 1e-12)
+
+
+@jax.jit
+def _tsne_grad(p: Array, y: Array):
+    """Gradient of KL(P‖Q) for the Student-t kernel; returns (grad, cost)."""
+    n = y.shape[0]
+    d = _pairwise_sq_dists(y)
+    num = 1.0 / (1.0 + d)
+    num = num * (1.0 - jnp.eye(n, dtype=y.dtype))
+    q = jnp.maximum(num / jnp.maximum(num.sum(), 1e-12), 1e-12)
+    pq = (p - q) * num  # (N,N)
+    grad = 4.0 * ((jnp.diag(pq.sum(1)) - pq) @ y)
+    cost = (p * (jnp.log(p) - jnp.log(q))).sum()
+    return grad, cost
+
+
+class Tsne:
+    """Exact t-SNE (ref plot/Tsne.java builder surface: maxIter, perplexity,
+    learningRate, switchMomentumIteration, stopLyingIteration)."""
+
+    def __init__(
+        self,
+        n_components: int = 2,
+        perplexity: float = 30.0,
+        learning_rate: float = 500.0,
+        max_iter: int = 1000,
+        initial_momentum: float = 0.5,
+        final_momentum: float = 0.8,
+        switch_momentum_iteration: int = 100,
+        stop_lying_iteration: int = 250,
+        exaggeration: float = 4.0,
+        min_gain: float = 0.01,
+        seed: int = 123,
+    ):
+        self.n_components = n_components
+        self.perplexity = perplexity
+        self.learning_rate = learning_rate
+        self.max_iter = max_iter
+        self.initial_momentum = initial_momentum
+        self.final_momentum = final_momentum
+        self.switch_momentum_iteration = switch_momentum_iteration
+        self.stop_lying_iteration = stop_lying_iteration
+        self.exaggeration = exaggeration
+        self.min_gain = min_gain
+        self.seed = seed
+        self.costs: Optional[np.ndarray] = None
+
+    def calculate(self, x, n_dims: Optional[int] = None,
+                  perplexity: Optional[float] = None) -> np.ndarray:
+        """Embed x (N,D) → (N, n_components). Ref Tsne.calculate."""
+        x = jnp.asarray(np.asarray(x, np.float32))
+        k = n_dims or self.n_components
+        perp = perplexity or self.perplexity
+        n = x.shape[0]
+        if n - 1 < 3 * perp:
+            perp = max((n - 1) / 3.0, 2.0)
+
+        p = _d2p(_pairwise_sq_dists(x), perp)
+
+        key = jax.random.PRNGKey(self.seed)
+        y0 = jax.random.normal(key, (n, k), jnp.float32) * 1e-4
+        lr = jnp.float32(self.learning_rate)
+
+        def step(i, carry):
+            y, vel, gains, costs = carry
+            momentum = jnp.where(
+                i < self.switch_momentum_iteration,
+                self.initial_momentum, self.final_momentum,
+            ).astype(y.dtype)
+            lying = (i < self.stop_lying_iteration).astype(y.dtype)
+            p_eff = p * (1.0 + (self.exaggeration - 1.0) * lying)
+            grad, cost = _tsne_grad(p_eff, y)
+            # adaptive per-element gains (ref Tsne.java:372-384)
+            same_sign = jnp.sign(grad) == jnp.sign(vel)
+            gains = jnp.maximum(
+                jnp.where(same_sign, gains * 0.8, gains + 0.2), self.min_gain
+            )
+            vel = momentum * vel - lr * gains * grad
+            y = y + vel
+            y = y - y.mean(0)
+            costs = costs.at[i].set(cost)
+            return y, vel, gains, costs
+
+        y, _, _, costs = jax.lax.fori_loop(
+            0, self.max_iter, step,
+            (y0, jnp.zeros_like(y0), jnp.ones_like(y0),
+             jnp.zeros((self.max_iter,), jnp.float32)),
+        )
+        self.costs = np.asarray(costs)
+        return np.asarray(y)
+
+    # ref Tsne.plot(matrix, nDims, labels, path) writes coords for the UI
+    def plot(self, x, n_dims: int, labels, path: str) -> np.ndarray:
+        y = self.calculate(x, n_dims)
+        with open(path, "w", encoding="utf-8") as f:
+            for row, label in zip(y, labels):
+                coords = ",".join(f"{v:.6f}" for v in row)
+                f.write(f"{coords},{label}\n")
+        return y

@@ -1,0 +1,151 @@
+"""GloVe / ParagraphVectors / vectorizer tests (ref: GloveTest.java,
+ParagraphVectorsTest.java, BagOfWordsVectorizerTest, TfidfVectorizerTest)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.glove import CoOccurrences, Glove
+from deeplearning4j_tpu.models.paragraph_vectors import ParagraphVectors
+from deeplearning4j_tpu.text.sentence_iterator import CollectionSentenceIterator
+from deeplearning4j_tpu.text.vectorizers import BagOfWordsVectorizer, TfidfVectorizer
+
+
+def _topic_corpus():
+    fruit = "apple banana cherry fruit sweet juice"
+    tech = "cpu gpu chip silicon compute memory"
+    rng = np.random.default_rng(0)
+    sents = []
+    for _ in range(150):
+        sents.append(" ".join(rng.permutation(fruit.split()).tolist()))
+        sents.append(" ".join(rng.permutation(tech.split()).tolist()))
+    return sents
+
+
+class TestCoOccurrences:
+    def test_window_weighting(self):
+        co = CoOccurrences(window=2)
+        co.add_sentence([0, 1, 2])
+        # pairs: (0,1) at dist 1 → 1.0; (1,2) at dist 1 → 1.0; (0,2) at dist 2 → 0.5
+        assert co.counts[(0, 1)] == pytest.approx(1.0)
+        assert co.counts[(1, 2)] == pytest.approx(1.0)
+        assert co.counts[(0, 2)] == pytest.approx(0.5)
+
+    def test_symmetric_key(self):
+        co = CoOccurrences(window=3)
+        co.add_sentence([5, 2])
+        co.add_sentence([2, 5])
+        assert co.counts[(2, 5)] == pytest.approx(2.0)
+
+
+class TestGlove:
+    def test_learns_topics(self):
+        glove = Glove(
+            sentence_iterator=CollectionSentenceIterator(_topic_corpus()),
+            layer_size=16, window=5, lr=0.1, iterations=25,
+            x_max=10.0, seed=2,
+        )
+        glove.fit()
+        assert glove.losses[-1] < glove.losses[0]
+        same = glove.similarity("apple", "banana")
+        cross = glove.similarity("apple", "gpu")
+        assert same > cross, (same, cross)
+        nearest = glove.words_nearest("cpu", 5)
+        tech = {"gpu", "chip", "silicon", "compute", "memory"}
+        assert len(tech & set(nearest)) >= 3, nearest
+
+    def test_unknown_word(self):
+        glove = Glove(
+            sentence_iterator=CollectionSentenceIterator(["a b c"] * 3),
+            layer_size=4, iterations=1,
+        )
+        glove.fit()
+        assert glove.word_vector("zzz") is None
+        assert np.isnan(glove.similarity("a", "zzz"))
+
+
+class TestParagraphVectors:
+    def test_doc_vectors_separate_topics(self):
+        fruit_docs = [(f"fruit_{i}", "apple banana cherry sweet juice fruit "
+                       "banana apple juice") for i in range(10)]
+        tech_docs = [(f"tech_{i}", "cpu gpu chip silicon compute memory "
+                      "gpu cpu compute") for i in range(10)]
+        pv = ParagraphVectors(
+            documents=fruit_docs + tech_docs,
+            layer_size=16, window=3, negative=5, iterations=30,
+            lr=0.25, sample=0, batch_size=128, seed=3, min_word_frequency=1,
+        )
+        pv.fit()
+        assert pv.doc_vectors.shape == (20, 16)
+        same = pv.similarity_docs("fruit_0", "fruit_1")
+        cross = pv.similarity_docs("fruit_0", "tech_0")
+        assert same > cross, (same, cross)
+        near = pv.nearest_docs("tech_0", 5)
+        assert sum(1 for lab in near if lab.startswith("tech_")) >= 4, near
+
+    def test_doc_vector_lookup(self):
+        pv = ParagraphVectors(
+            documents=[("d1", "a b c"), ("d2", "b c d")],
+            layer_size=8, iterations=1, min_word_frequency=1,
+        )
+        pv.fit()
+        assert pv.doc_vector("d1") is not None
+        assert pv.doc_vector("nope") is None
+
+
+class TestVectorizers:
+    DOCS = ["the cat sat on the mat", "the dog sat on the log",
+            "cats and dogs are animals"]
+
+    def test_bow_counts(self):
+        bow = BagOfWordsVectorizer()
+        m = bow.fit_transform(self.DOCS)
+        assert m.shape[0] == 3
+        the = bow.vocab.index_of("the")
+        assert m[0, the] == 2.0
+        assert m[2, the] == 0.0
+
+    def test_bow_vectorize_with_label(self):
+        bow = BagOfWordsVectorizer().fit(self.DOCS)
+        features, onehot = bow.vectorize("the cat", label=1, num_labels=3)
+        assert features[bow.vocab.index_of("cat")] == 1.0
+        assert onehot.tolist() == [0.0, 1.0, 0.0]
+
+    def test_tfidf_downweights_common_terms(self):
+        tv = TfidfVectorizer()
+        m = tv.fit_transform(self.DOCS)
+        the = tv.vocab.index_of("the")  # in 2/3 docs
+        cat = tv.vocab.index_of("cat")  # in 1/3 docs
+        # 'the' appears twice in doc0 but idf penalty keeps it below 'cat'
+        assert m[0, cat] > 0
+        assert tv.idf[cat] > tv.idf[the]
+
+    def test_transform_unseen_word_ignored(self):
+        tv = TfidfVectorizer().fit(self.DOCS)
+        m = tv.transform(["unseen words only"])
+        assert m.shape == (1, tv.vocab.num_words())
+        assert m.sum() == 0.0
+
+
+class TestBinarySerializer:
+    def test_binary_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.models.embeddings import (
+            InMemoryLookupTable,
+            load_word_vectors_binary,
+            write_word_vectors_binary,
+        )
+        from deeplearning4j_tpu.text.vocab import VocabCache
+
+        vocab = VocabCache()
+        for w in ["alpha", "beta", "gamma"]:
+            for _ in range(3):
+                vocab.add_token(w)
+        vocab.finish(1)
+        table = InMemoryLookupTable(vocab, layer_size=7, negative=1)
+        path = str(tmp_path / "vec.bin")
+        write_word_vectors_binary(table, path)
+        vocab2, mat = load_word_vectors_binary(path)
+        assert vocab2.num_words() == 3
+        for w in ["alpha", "beta", "gamma"]:
+            np.testing.assert_array_equal(
+                mat[vocab2.index_of(w)], table.syn0[vocab.index_of(w)]
+            )

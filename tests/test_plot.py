@@ -1,0 +1,125 @@
+"""t-SNE + renderer tests (ref: plot/TsneTest.java, BarnesHutTsneTest.java —
+embed a small labeled set, assert shapes/finiteness and that same-class
+points end up closer than cross-class)."""
+
+import json
+import os
+
+import numpy as np
+
+from deeplearning4j_tpu.plot import BarnesHutTsne, FilterRenderer, NeuralNetPlotter, Tsne
+
+
+def _clusters(n_per=25, d=10, seed=0):
+    rng = np.random.RandomState(seed)
+    a = rng.randn(n_per, d) * 0.3
+    b = rng.randn(n_per, d) * 0.3 + 5.0
+    x = np.concatenate([a, b]).astype(np.float32)
+    labels = np.array([0] * n_per + [1] * n_per)
+    return x, labels
+
+
+def _separation(y, labels):
+    same = np.mean([np.linalg.norm(y[i] - y[j])
+                    for i in range(len(y)) for j in range(i + 1, len(y))
+                    if labels[i] == labels[j]])
+    cross = np.mean([np.linalg.norm(y[i] - y[j])
+                     for i in range(len(y)) for j in range(i + 1, len(y))
+                     if labels[i] != labels[j]])
+    return same, cross
+
+
+def test_exact_tsne_separates_clusters():
+    x, labels = _clusters()
+    tsne = Tsne(max_iter=300, perplexity=10.0, learning_rate=100.0, seed=7)
+    y = tsne.calculate(x)
+    assert y.shape == (50, 2)
+    assert np.all(np.isfinite(y))
+    same, cross = _separation(y, labels)
+    assert cross > 2 * same, (same, cross)
+    # KL cost decreased after the early-exaggeration phase
+    assert tsne.costs[-1] < tsne.costs[260]
+
+
+def test_tsne_plot_writes_coords(tmp_path):
+    x, labels = _clusters(n_per=10)
+    path = str(tmp_path / "coords.csv")
+    y = Tsne(max_iter=50).plot(x, 2, labels, path)
+    lines = open(path).read().strip().split("\n")
+    assert len(lines) == 20
+    assert len(lines[0].split(",")) == 3  # x, y, label
+    assert y.shape == (20, 2)
+
+
+def test_barnes_hut_tsne_separates_clusters():
+    x, labels = _clusters(n_per=20)
+    bh = BarnesHutTsne(theta=0.5, perplexity=8.0, max_iter=300,
+                       learning_rate=100.0, seed=7)
+    y = bh.fit_transform(x)
+    assert y.shape == (40, 2)
+    assert np.all(np.isfinite(y))
+    same, cross = _separation(y, labels)
+    assert cross > 1.5 * same, (same, cross)
+
+
+def test_barnes_hut_theta_zero_matches_exact_gradient():
+    """theta=0 disables approximation: BH gradient == dense gradient on the
+    same sparse P (repulsion exact over all pairs)."""
+    rng = np.random.RandomState(1)
+    y = rng.randn(15, 2)
+    # dense symmetric P restricted to a k-NN pattern
+    from deeplearning4j_tpu.plot.barnes_hut_tsne import _knn_affinities
+    x = rng.randn(15, 4)
+    rows, cols, vals = _knn_affinities(x, k=5, perplexity=3.0)
+    bh = BarnesHutTsne(theta=0.0)
+    g = bh.gradient(rows, cols, vals, y)
+    # dense computation
+    n = len(y)
+    p = np.zeros((n, n))
+    for i in range(n):
+        for ptr in range(rows[i], rows[i + 1]):
+            p[i, cols[ptr]] = vals[ptr]
+    d = ((y[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+    num = 1.0 / (1.0 + d)
+    np.fill_diagonal(num, 0.0)
+    z = num.sum()
+    pos = np.zeros_like(y)
+    neg = np.zeros_like(y)
+    for i in range(n):
+        for j in range(n):
+            if i == j:
+                continue
+            pos[i] += p[i, j] * num[i, j] * (y[i] - y[j])
+            neg[i] += num[i, j] ** 2 * (y[i] - y[j]) / z
+    np.testing.assert_allclose(g, pos - neg, atol=1e-8)
+
+
+def test_neural_net_plotter(tmp_path):
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .n_in(4).n_out(8).num_iterations(1).list(2)
+        .override(0, layer_type="DENSE")
+        .override(1, layer_type="OUTPUT", n_in=8, n_out=3,
+                  activation_function="softmax", loss_function="MCXENT")
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    plotter = NeuralNetPlotter(out_dir=str(tmp_path))
+    html = plotter.plot_weight_histograms(net)
+    assert os.path.exists(html)
+    data = json.load(open(html.replace(".html", ".json")))
+    assert "layer0_W" in data and "counts" in data["layer0_W"]
+    act_path = plotter.plot_activations(net, np.zeros((5, 4), np.float32))
+    assert "activation_layer0" in json.load(open(act_path))
+
+
+def test_filter_renderer(tmp_path):
+    rng = np.random.RandomState(0)
+    w = rng.randn(16, 6)  # 4x4 patches, 6 filters
+    path = str(tmp_path / "filters.svg")
+    FilterRenderer().render_filters(w, path, 4, 4, cols=3)
+    svg = open(path).read()
+    assert svg.startswith("<svg") and svg.count("<rect") == 16 * 6
