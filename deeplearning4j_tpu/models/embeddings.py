@@ -136,9 +136,8 @@ def cosine_nearest(matrix: np.ndarray, query: np.ndarray, n: int,
     optionally excluding one row (the query's own index)."""
     normed = matrix / np.maximum(np.linalg.norm(matrix, axis=1, keepdims=True), 1e-12)
     sims = normed @ (query / max(np.linalg.norm(query), 1e-12))
-    if exclude >= 0:
-        sims[exclude] = -np.inf
-    return [int(i) for i in np.argsort(-sims)[:n]]
+    order = [int(i) for i in np.argsort(-sims) if i != exclude]
+    return order[:n]
 
 def cosine_sim(v1: Optional[np.ndarray], v2: Optional[np.ndarray]) -> float:
     if v1 is None or v2 is None:

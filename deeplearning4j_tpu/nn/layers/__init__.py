@@ -21,6 +21,7 @@ from deeplearning4j_tpu.nn.layers import (
     lstm,
     output,
     rbm,
+    recursive_autoencoder,
     subsampling,
 )
 
@@ -29,7 +30,7 @@ _FORWARD = {
     LayerType.OUTPUT: output.forward,
     LayerType.RBM: rbm.forward,
     LayerType.AUTOENCODER: autoencoder.forward,
-    LayerType.RECURSIVE_AUTOENCODER: autoencoder.forward,
+    LayerType.RECURSIVE_AUTOENCODER: recursive_autoencoder.forward,
     LayerType.CONVOLUTION: convolution.forward,
     LayerType.SUBSAMPLING: subsampling.forward,
     LayerType.LSTM: lstm.forward,

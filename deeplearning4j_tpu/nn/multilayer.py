@@ -28,6 +28,7 @@ from deeplearning4j_tpu.nn.api import LayerType, OptimizationAlgorithm
 from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration, NeuralNetConfiguration
 from deeplearning4j_tpu.nn.gradient import flatten_params, num_params, unflatten_params
 from deeplearning4j_tpu.nn.layers import autoencoder as ae_ops
+from deeplearning4j_tpu.nn.layers import recursive_autoencoder as rae_ops
 from deeplearning4j_tpu.nn.layers import output as output_ops
 from deeplearning4j_tpu.nn.layers import rbm as rbm_ops
 from deeplearning4j_tpu.ops.rng import KeySequence
@@ -213,10 +214,13 @@ class MultiLayerNetwork:
                 algo=OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT,
             )
         if conf.layer_type in (LayerType.AUTOENCODER, LayerType.RECURSIVE_AUTOENCODER):
+            # fresh corruption mask each iteration for the denoising AE (ref
+            # corrupts per gradient call, AutoEncoder.java getCorruptedInput)
+            ops = (ae_ops if conf.layer_type == LayerType.AUTOENCODER
+                   else rae_ops)
+
             def score_fn(p, key):
-                # fresh corruption mask each iteration (ref corrupts per
-                # gradient call, AutoEncoder.java getCorruptedInput)
-                return ae_ops.pretrain_loss(conf, p, x, key)
+                return ops.pretrain_loss(conf, p, x, key)
 
             solver = Solver(conf, score_fn, listeners=self.listeners,
                             num_iterations=conf.num_iterations)

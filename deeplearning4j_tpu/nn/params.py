@@ -43,6 +43,18 @@ def _pretrain_params(key, conf: NeuralNetConfiguration) -> Dict[str, jax.Array]:
     return p
 
 
+def _recursive_ae_params(key, conf: NeuralNetConfiguration) -> Dict[str, jax.Array]:
+    # combine matrix maps [x_i; parent] (n_in + n_out) -> n_out, decoded with
+    # the transpose (ref: RecursiveAutoEncoder.java / RecursiveParamInitializer)
+    in_dim = conf.n_in + conf.n_out
+    wkey, _ = jax.random.split(key)
+    return {
+        WEIGHT_KEY: init_weights(wkey, (in_dim, conf.n_out), conf.weight_init, conf.dist),
+        BIAS_KEY: jnp.zeros((conf.n_out,)),
+        VISIBLE_BIAS_KEY: jnp.zeros((in_dim,)),
+    }
+
+
 def _conv_params(key, conf: NeuralNetConfiguration) -> Dict[str, jax.Array]:
     # OIHW filters: (out_channels, in_channels, kh, kw). The reference stores
     # per-feature-map filters of shape filterSize and loops convn over maps
@@ -75,8 +87,10 @@ def init_layer_params(key: jax.Array, conf: NeuralNetConfiguration) -> Dict[str,
     t = conf.layer_type
     if t in (LayerType.DENSE, LayerType.OUTPUT):
         return _dense_params(key, conf)
-    if t in (LayerType.RBM, LayerType.AUTOENCODER, LayerType.RECURSIVE_AUTOENCODER):
+    if t in (LayerType.RBM, LayerType.AUTOENCODER):
         return _pretrain_params(key, conf)
+    if t == LayerType.RECURSIVE_AUTOENCODER:
+        return _recursive_ae_params(key, conf)
     if t == LayerType.CONVOLUTION:
         return _conv_params(key, conf)
     if t == LayerType.SUBSAMPLING:

@@ -28,8 +28,8 @@ class BagOfWordsVectorizer:
         return self.tokenizer_factory.create(text).get_tokens()
 
     def fit(self, documents: Sequence[str]) -> "BagOfWordsVectorizer":
-        # tokenize each document exactly once; subclasses (tf-idf) reuse the
-        # cached token lists for their document-frequency pass
+        # tokenize each document exactly once; the lists are reused by the
+        # tf-idf subclass's df pass and by fit_transform, then released
         self._fit_tokens = [self._tokens(doc) for doc in documents]
         for toks in self._fit_tokens:
             for tok in toks:
@@ -37,18 +37,28 @@ class BagOfWordsVectorizer:
         self.vocab.finish(self.min_word_frequency)
         return self
 
-    def transform(self, documents: Sequence[str]) -> np.ndarray:
-        v = self.vocab.num_words()
-        out = np.zeros((len(documents), v), np.float32)
-        for r, doc in enumerate(documents):
-            for tok in self._tokens(doc):
+    def _count_matrix(self, token_lists: Sequence[List[str]]) -> np.ndarray:
+        out = np.zeros((len(token_lists), self.vocab.num_words()), np.float32)
+        for r, toks in enumerate(token_lists):
+            for tok in toks:
                 i = self.vocab.index_of(tok)
                 if i >= 0:
                     out[r, i] += 1.0
         return out
 
+    def _postprocess(self, counts: np.ndarray) -> np.ndarray:
+        return counts
+
+    def transform(self, documents: Sequence[str]) -> np.ndarray:
+        return self._postprocess(
+            self._count_matrix([self._tokens(d) for d in documents])
+        )
+
     def fit_transform(self, documents: Sequence[str]) -> np.ndarray:
-        return self.fit(documents).transform(documents)
+        self.fit(documents)
+        m = self._postprocess(self._count_matrix(self._fit_tokens))
+        self._fit_tokens = None  # release the cached corpus
+        return m
 
     def vectorize(self, text: str, label: Optional[int] = None,
                   num_labels: Optional[int] = None
@@ -83,8 +93,7 @@ class TfidfVectorizer(BagOfWordsVectorizer):
         self.idf = np.log(len(documents) / (1.0 + df)).astype(np.float32) + 1.0
         return self
 
-    def transform(self, documents: Sequence[str]) -> np.ndarray:
+    def _postprocess(self, counts: np.ndarray) -> np.ndarray:
         assert self.idf is not None, "fit first"
-        counts = super().transform(documents)
         totals = np.maximum(counts.sum(axis=1, keepdims=True), 1.0)
         return (counts / totals) * self.idf[None, :]

@@ -1,0 +1,154 @@
+"""Tree / RNTN / RecursiveAutoEncoder tests (ref: RNTNTest.java,
+TreeTests, RecursiveAutoEncoderTest)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.rntn import RNTN, RNTNEval
+from deeplearning4j_tpu.nn.tree import Tree, linearize
+
+
+class TestTree:
+    def test_parse_and_structure(self):
+        t = Tree.parse("(3 (2 good) (3 (2 great) (2 movie)))")
+        assert t.label == 3
+        assert t.yield_words() == ["good", "great", "movie"]
+        assert t.num_nodes() == 5
+        assert t.depth() == 2
+        assert [n.label for n in t.preorder()] == [3, 2, 3, 2, 2]
+
+    def test_parse_rejects_trailing(self):
+        with pytest.raises(AssertionError):
+            Tree.parse("(1 a) (2 b)")
+
+    def test_binarize_nary(self):
+        t = Tree.parse("(1 (0 a) (0 b) (0 c))")
+        b = t.binarize()
+        assert all(len(n.children) in (0, 2) for n in b.preorder())
+        assert b.yield_words() == ["a", "b", "c"]
+
+    def test_linearize(self):
+        t = Tree.parse("(3 (1 bad) (2 movie))").binarize()
+        vocab = {"bad": 1, "movie": 2}
+        leaf_ids, merges, labels = linearize(t, vocab)
+        assert leaf_ids.tolist() == [1, 2]
+        assert merges.tolist() == [[0, 1, 2]]
+        assert labels.tolist() == [1, 2, 3]
+
+    def test_linearize_unknown_word(self):
+        t = Tree.parse("(1 (0 known) (0 zzz))").binarize()
+        leaf_ids, _, _ = linearize(t, {"known": 1}, unk_index=0)
+        assert leaf_ids.tolist() == [1, 0]
+
+
+def _sentiment_corpus():
+    """Tiny synthetic sentiment task: 'good'-rooted trees are positive (1),
+    'bad'-rooted are negative (0)."""
+    pos = ["(1 (1 good) (1 movie))", "(1 (1 great) (1 film))",
+           "(1 (1 good) (1 film))", "(1 (1 great) (1 movie))",
+           "(1 (1 (1 very) (1 good)) (1 movie))"]
+    neg = ["(0 (0 bad) (0 movie))", "(0 (0 awful) (0 film))",
+           "(0 (0 bad) (0 film))", "(0 (0 awful) (0 movie))",
+           "(0 (0 (0 very) (0 bad)) (0 movie))"]
+    return [Tree.parse(s) for s in pos + neg]
+
+
+class TestRNTN:
+    def test_learns_toy_sentiment(self):
+        trees = _sentiment_corpus()
+        model = RNTN(num_hidden=8, num_classes=2, lr=0.25, iterations=60,
+                     l2=1e-5, seed=0)
+        model.fit(trees)
+        assert model.losses[-1] < model.losses[0]
+        ev = RNTNEval()
+        ev.eval(model, trees)
+        assert ev.root_accuracy() >= 0.9, ev.stats()
+        assert ev.node_accuracy() >= 0.8, ev.stats()
+
+    def test_predict_root_unseen_composition(self):
+        trees = _sentiment_corpus()
+        model = RNTN(num_hidden=8, num_classes=2, lr=0.25, iterations=60,
+                     l2=1e-5, seed=0)
+        model.fit(trees)
+        # novel combination of seen words
+        t = Tree.parse("(1 (1 great) (1 great))")
+        assert model.predict_root(t) in (0, 1)
+
+    def test_eval_stats_format(self):
+        ev = RNTNEval()
+        assert "node acc" in ev.stats()
+
+
+class TestRecursiveAutoEncoder:
+    def _conf(self):
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+
+        return (NeuralNetConfiguration.Builder()
+                .n_in(6).n_out(4).activation_function("tanh")
+                .lr(0.05).num_iterations(80).seed(3)
+                .weight_init("VI").build())
+
+    def test_param_shapes(self):
+        from deeplearning4j_tpu.nn.params import init_layer_params
+        import dataclasses
+
+        conf = dataclasses.replace(self._conf(), layer_type="RECURSIVE_AUTOENCODER")
+        p = init_layer_params(jax.random.PRNGKey(0), conf)
+        assert p["W"].shape == (10, 4)
+        assert p["b"].shape == (4,)
+        assert p["vb"].shape == (10,)
+
+    def test_pretrain_reduces_reconstruction_error(self):
+        import dataclasses
+
+        from deeplearning4j_tpu.nn.layers import recursive_autoencoder as rae
+        from deeplearning4j_tpu.nn.params import init_layer_params
+        from deeplearning4j_tpu.optimize.solver import Solver
+
+        conf = dataclasses.replace(self._conf(), layer_type="RECURSIVE_AUTOENCODER")
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.rand(12, 6).astype(np.float32))
+        params = init_layer_params(jax.random.PRNGKey(1), conf)
+        loss0 = float(rae.pretrain_loss(conf, params, x, jax.random.PRNGKey(2)))
+        solver = Solver(conf, lambda p, k: rae.pretrain_loss(conf, p, x, k),
+                        num_iterations=conf.num_iterations)
+        trained = solver.optimize(params, jax.random.PRNGKey(3))
+        loss1 = float(rae.pretrain_loss(conf, trained, x, jax.random.PRNGKey(2)))
+        assert loss1 < loss0 * 0.7, (loss0, loss1)
+
+    def test_forward_shape_and_sequence_encoding(self):
+        import dataclasses
+
+        from deeplearning4j_tpu.nn.layers import recursive_autoencoder as rae
+        from deeplearning4j_tpu.nn.params import init_layer_params
+
+        conf = dataclasses.replace(self._conf(), layer_type="RECURSIVE_AUTOENCODER")
+        params = init_layer_params(jax.random.PRNGKey(0), conf)
+        x = jnp.zeros((5, 6), jnp.float32)
+        assert rae.forward(conf, params, x).shape == (5, 4)
+        assert rae.encode_sequence(conf, params, x).shape == (4,)
+
+    def test_pretrain_through_multilayer(self):
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        conf = (NeuralNetConfiguration.Builder()
+                .n_in(6).n_out(4).activation_function("tanh")
+                .lr(0.05).num_iterations(20).seed(3).weight_init("VI")
+                .list(2)
+                .override(0, layer_type="RECURSIVE_AUTOENCODER")
+                .override(1, layer_type="OUTPUT", n_in=4, n_out=2,
+                          activation_function="softmax", loss_function="MCXENT")
+                .pretrain(True).backward(True)
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(1)
+        x = rng.rand(16, 6).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 16)]
+        net.pretrain(x)
+        net.fit(x, y)  # full path still works with the RAE in the stack
+        out = net.output(x)
+        assert out.shape == (16, 2)
+        assert np.all(np.isfinite(np.asarray(out)))
