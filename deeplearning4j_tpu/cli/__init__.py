@@ -1,0 +1,12 @@
+"""Command-line interface: train / test / predict.
+
+Parity with ref deeplearning4j-cli (cli/subcommands/Train.java flags
+-conf/-input/-model/-type/-savemode/-verbose, Test/Predict subcommands,
+CommandLineInterfaceDriver). argparse replaces args4j; input formats dispatch
+on file extension (csv / svmLight) the way the reference dispatches on its
+URI Scheme registry (cli/api/schemes/).
+"""
+
+from deeplearning4j_tpu.cli.driver import main
+
+__all__ = ["main"]

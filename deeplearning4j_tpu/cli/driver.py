@@ -1,0 +1,148 @@
+"""CLI driver (ref: cli/driver/CommandLineInterfaceDriver.java +
+cli/subcommands/{Train,Test,Predict}.java)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.records import (
+    CSVRecordReader,
+    RecordReaderDataSetIterator,
+    SVMLightRecordReader,
+)
+from deeplearning4j_tpu.eval.evaluation import Evaluation
+from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _make_iterator(path: str, batch: int, num_labels: Optional[int],
+                   num_features: Optional[int], label_index: int):
+    """Extension-dispatched reader (ref Train.java input-format handling)."""
+    if path.endswith((".svm", ".svmlight", ".libsvm")):
+        if not num_features:
+            raise SystemExit("--features is required for svmLight input")
+        reader = SVMLightRecordReader(path, num_features)
+    else:
+        reader = CSVRecordReader(path)
+    return RecordReaderDataSetIterator(reader, batch,
+                                       label_index=label_index,
+                                       num_possible_labels=num_labels)
+
+
+def _npz_path(path: str) -> str:
+    # np.savez appends .npz to extension-less paths; normalize both ends so
+    # `--model m` round-trips between train and test/predict
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def _load_model(conf_path: str, params_path: Optional[str]) -> MultiLayerNetwork:
+    with open(conf_path, "r", encoding="utf-8") as f:
+        conf = MultiLayerConfiguration.from_json(f.read())
+    net = MultiLayerNetwork(conf).init()
+    if params_path:
+        flat = np.load(_npz_path(params_path))["params"]
+        net.set_params(flat)
+    return net
+
+
+def _save_model(net: MultiLayerNetwork, path: str) -> None:
+    np.savez(_npz_path(path), params=np.asarray(net.params()))
+
+
+def train(args) -> int:
+    net = _load_model(args.conf, None)
+    it = _make_iterator(args.input, args.batch, args.labels,
+                        args.features, args.label_index)
+    for _ in range(args.epochs):
+        it.reset()
+        net.fit(it)
+    _save_model(net, args.model)
+    if args.verbose:
+        print(f"saved params to {args.model}")
+    return 0
+
+
+def test(args) -> int:
+    net = _load_model(args.conf, args.model)
+    it = _make_iterator(args.input, args.batch, args.labels,
+                        args.features, args.label_index)
+    ev = Evaluation()
+    it.reset()
+    while it.has_next():
+        ds = it.next()
+        ev.eval(ds.labels, np.asarray(net.output(ds.features)))
+    print(ev.stats())
+    return 0
+
+
+def predict(args) -> int:
+    net = _load_model(args.conf, args.model)
+    it = _make_iterator(args.input, args.batch, args.labels,
+                        args.features, args.label_index)
+    rows: List[str] = []
+    it.reset()
+    while it.has_next():
+        ds = it.next()
+        preds = net.predict(ds.features)
+        rows.extend(str(int(p)) for p in preds)
+    out = "\n".join(rows) + "\n"
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as f:
+            f.write(out)
+        if args.verbose:
+            print(f"wrote {len(rows)} predictions to {args.output}")
+    else:
+        sys.stdout.write(out)
+    return 0
+
+
+def _add_common(p: argparse.ArgumentParser, needs_model_in: bool) -> None:
+    p.add_argument("--conf", required=True, help="model conf JSON path")
+    p.add_argument("--input", required=True, help="input data (csv or svmLight)")
+    p.add_argument("--model", required=True,
+                   help="params .npz path (%s)" %
+                        ("read" if needs_model_in else "written"))
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--labels", type=int, default=None,
+                   help="number of classes (omit for regression)")
+    p.add_argument("--features", type=int, default=None,
+                   help="feature count (required for svmLight)")
+    p.add_argument("--label-index", type=int, default=-1,
+                   help="label column (-1 = last)")
+    p.add_argument("--verbose", action="store_true")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="dl4j-tpu", description="train/test/predict neural networks"
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_train = sub.add_parser("train", help="fit a model and save params")
+    _add_common(p_train, needs_model_in=False)
+    p_train.add_argument("--epochs", type=int, default=1)
+    p_train.set_defaults(func=train)
+
+    p_test = sub.add_parser("test", help="evaluate a saved model")
+    _add_common(p_test, needs_model_in=True)
+    p_test.set_defaults(func=test)
+
+    p_pred = sub.add_parser("predict", help="write class predictions")
+    _add_common(p_pred, needs_model_in=True)
+    p_pred.add_argument("--output", default=None,
+                        help="predictions file (default: stdout)")
+    p_pred.set_defaults(func=predict)
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -4,3 +4,11 @@ from deeplearning4j_tpu.datasets.iterator import (  # noqa: F401
     DataSetIterator,
     ListDataSetIterator,
 )
+from deeplearning4j_tpu.datasets.records import (  # noqa: F401
+    CSVRecordReader,
+    ImageRecordReader,
+    ListStringRecordReader,
+    RecordReader,
+    RecordReaderDataSetIterator,
+    SVMLightRecordReader,
+)

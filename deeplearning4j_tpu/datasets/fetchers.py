@@ -229,3 +229,59 @@ class CurvesDataFetcher(BaseDataFetcher):
         )
         x = (x - x.min()) / (x.max() - x.min())
         super().__init__(x.astype(np.float32), x.astype(np.float32).copy())
+
+
+def synthetic_faces(num_examples: int, num_people: int = 5, width: int = 28,
+                    height: int = 28, seed: int = 11
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """LFW-shaped surrogate (faces → person id): per-class smooth 'face'
+    prototype (blurred blobs) + noise. Used when the real LFW archive is
+    unavailable (zero-egress environments)."""
+    rng = np.random.default_rng(seed)
+    proto_rng = np.random.default_rng(4321)
+    protos = np.zeros((num_people, height, width), np.float32)
+    yy, xx = np.mgrid[0:height, 0:width]
+    for p in range(num_people):
+        for _ in range(4):
+            cy = proto_rng.uniform(4, max(height - 4, 5))
+            cx = proto_rng.uniform(4, max(width - 4, 5))
+            sig = proto_rng.uniform(2.0, 5.0)
+            protos[p] += np.exp(-((yy - cy) ** 2 + (xx - cx) ** 2) / (2 * sig**2))
+        protos[p] /= protos[p].max()
+    y = rng.integers(0, num_people, num_examples)
+    x = protos[y] + rng.normal(0, 0.1, (num_examples, height, width)).astype(np.float32)
+    return np.clip(x, 0, 1).reshape(num_examples, height * width), y
+
+
+class LFWDataFetcher(BaseDataFetcher):
+    """Labeled-Faces-in-the-Wild fetcher (ref: LFWDataFetcher/LFWLoader —
+    downloads+scales the lfw archive). Reads an already-extracted LFW-style
+    directory tree (person-name subdirs of .pgm/.ppm/.npy images) when
+    ``path`` is given; otherwise falls back to a synthetic face set
+    (no network egress here, ref downloads from vis-www.cs.umass.edu)."""
+
+    def __init__(self, num_examples: int = 500, path: Optional[str] = None,
+                 width: int = 28, height: int = 28):
+        if path is not None:
+            from itertools import islice
+
+            from deeplearning4j_tpu.datasets.records import ImageRecordReader
+
+            reader = ImageRecordReader(path, width=width, height=height,
+                                       append_label=True)
+            rows = list(islice(reader, num_examples))
+            if not rows:
+                raise ValueError(
+                    f"no readable images under {path!r} — ImageRecordReader "
+                    "supports .pgm/.ppm/.pnm/.npy files (convert .jpg LFW "
+                    "archives first, e.g. with `mogrify -format ppm`)"
+                )
+            mat = np.asarray(rows, np.float32)
+            x, y = mat[:, :-1], mat[:, -1].astype(np.int64)
+            self.num_people = len(reader.labels)
+        else:
+            self.num_people = 5
+            x, y = synthetic_faces(num_examples, self.num_people,
+                                   width=width, height=height)
+        super().__init__(x[:num_examples],
+                         _one_hot(y[:num_examples], self.num_people))

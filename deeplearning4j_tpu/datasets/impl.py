@@ -34,3 +34,15 @@ class IrisDataSetIterator(BaseDatasetIterator):
 class CurvesDataSetIterator(BaseDatasetIterator):
     def __init__(self, batch: int, num_examples: int = 1000):
         super().__init__(batch, num_examples, CurvesDataFetcher(num_examples))
+
+
+class LFWDataSetIterator(BaseDatasetIterator):
+    """ref: datasets/iterator/impl/LFWDataSetIterator.java"""
+
+    def __init__(self, batch: int, num_examples: int = 500,
+                 path=None, width: int = 28, height: int = 28):
+        from deeplearning4j_tpu.datasets.fetchers import LFWDataFetcher
+
+        super().__init__(batch, num_examples,
+                         LFWDataFetcher(num_examples, path=path,
+                                        width=width, height=height))

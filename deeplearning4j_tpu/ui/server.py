@@ -1,0 +1,154 @@
+"""Stdlib HTTP server exposing training artifacts (ref: ui/UiServer.java)."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+INDEX_HTML = """<!doctype html>
+<html><head><title>deeplearning4j-tpu ui</title></head><body>
+<h1>deeplearning4j-tpu</h1>
+<ul>
+<li><a href="/api/words">word vectors (count)</a></li>
+<li><a href="/api/nearest?word=WORD&n=5">nearest neighbours</a></li>
+<li><a href="/api/tsne">t-SNE coords</a></li>
+<li><a href="/api/weights">weight histograms</a></li>
+<li><a href="/artifacts/">artifact files</a></li>
+</ul></body></html>"""
+
+
+class UiServer:
+    """In-process artifact server. Register data, then serve:
+
+        server = UiServer(artifact_dir="plots")
+        server.upload_word_vectors(vocab_words, matrix)
+        server.upload_tsne(coords, labels)
+        server.start(port=0)   # port 0 → ephemeral; .port has the real one
+    """
+
+    def __init__(self, artifact_dir: Optional[str] = None):
+        self.artifact_dir = artifact_dir
+        self._words: List[str] = []
+        self._vectors: Optional[np.ndarray] = None
+        self._vptree = None
+        self._tsne: Optional[Dict] = None
+        self._weights: Optional[Dict] = None
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.port: Optional[int] = None
+
+    # ---- uploads (ref ApiResource: the reference POSTs these; in-process
+    # registration serves the same purpose without copying through HTTP) ----
+    def upload_word_vectors(self, words: List[str], vectors: np.ndarray) -> None:
+        from deeplearning4j_tpu.clustering.vptree import VPTree
+
+        self._words = list(words)
+        self._vectors = np.asarray(vectors, np.float64)
+        self._vptree = VPTree(self._vectors, labels=self._words,
+                              similarity="cosine")
+
+    def upload_tsne(self, coords: np.ndarray, labels: List[str]) -> None:
+        self._tsne = {
+            "coords": np.asarray(coords).tolist(),
+            "labels": [str(l) for l in labels],
+        }
+
+    def upload_weight_histograms(self, histograms: Dict) -> None:
+        self._weights = histograms
+
+    # ---- queries ----
+    def nearest(self, word: str, n: int = 5) -> List[Dict]:
+        if self._vptree is None or word not in self._words:
+            return []
+        idx = self._words.index(word)
+        hits = self._vptree.search(self._vectors[idx], n + 1)
+        return [
+            {"word": self._words[i], "distance": float(d)}
+            for i, d in hits if i != idx
+        ][:n]
+
+    # ---- http plumbing ----
+    def _handler_class(self):
+        ui = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # silence request logging
+                pass
+
+            def _send(self, code: int, body: bytes,
+                      ctype: str = "application/json") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _json(self, obj, code: int = 200) -> None:
+                self._send(code, json.dumps(obj).encode("utf-8"))
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                q = parse_qs(url.query)
+                if url.path in ("/", "/index.html"):
+                    self._send(200, INDEX_HTML.encode(), "text/html")
+                elif url.path == "/api/words":
+                    self._json({"count": len(ui._words), "words": ui._words[:200]})
+                elif url.path == "/api/nearest":
+                    word = q.get("word", [""])[0]
+                    try:
+                        n = int(q.get("n", ["5"])[0])
+                    except ValueError:
+                        self._json({"error": "n must be an integer"}, 400)
+                        return
+                    self._json({"word": word, "neighbours": ui.nearest(word, n)})
+                elif url.path == "/api/tsne":
+                    self._json(ui._tsne or {})
+                elif url.path == "/api/weights":
+                    self._json(ui._weights or {})
+                elif url.path.startswith("/artifacts/") and ui.artifact_dir:
+                    rel = url.path[len("/artifacts/"):]
+                    base = os.path.realpath(ui.artifact_dir)
+                    if not os.path.isdir(base):
+                        self._json({"error": "artifact dir missing"}, 404)
+                        return
+                    if not rel:
+                        files = sorted(os.listdir(base))
+                        self._send(200, "\n".join(files).encode(), "text/plain")
+                        return
+                    full = os.path.realpath(os.path.join(base, rel))
+                    # confine to the artifact dir (no ../ escapes)
+                    if not full.startswith(base + os.sep) or not os.path.isfile(full):
+                        self._json({"error": "not found"}, 404)
+                        return
+                    ctype = ("image/svg+xml" if full.endswith(".svg")
+                             else "text/html" if full.endswith(".html")
+                             else "application/json" if full.endswith(".json")
+                             else "application/octet-stream")
+                    with open(full, "rb") as fh:
+                        self._send(200, fh.read(), ctype)
+                else:
+                    self._json({"error": "not found"}, 404)
+
+        return Handler
+
+    def start(self, port: int = 8080, host: str = "127.0.0.1") -> int:
+        assert self._httpd is None, "already started"
+        self._httpd = ThreadingHTTPServer((host, port), self._handler_class())
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._thread = None

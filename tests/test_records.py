@@ -1,0 +1,166 @@
+"""Record reader tests (ref: RecordReaderDataSetiteratorTest,
+CSVDataSetIteratorTest, svmLight fixtures)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.records import (
+    CSVRecordReader,
+    ImageRecordReader,
+    ListStringRecordReader,
+    RecordReaderDataSetIterator,
+    SVMLightRecordReader,
+    load_image,
+    read_pnm,
+)
+
+
+@pytest.fixture
+def csv_file(tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text("# header\n" if False else "5.1,3.5,1.4,0.2,0\n"
+                 "4.9,3.0,1.4,0.2,0\n"
+                 "6.3,3.3,6.0,2.5,2\n"
+                 "5.8,2.7,5.1,1.9,2\n"
+                 "7.0,3.2,4.7,1.4,1\n")
+    return str(p)
+
+
+class TestCSV:
+    def test_reads_all_rows(self, csv_file):
+        rows = list(CSVRecordReader(csv_file))
+        assert len(rows) == 5
+        assert rows[0] == [5.1, 3.5, 1.4, 0.2, 0.0]
+
+    def test_skip_lines(self, tmp_path):
+        p = tmp_path / "h.csv"
+        p.write_text("a,b\n1,2\n3,4\n")
+        rows = list(CSVRecordReader(str(p), skip_lines=1))
+        assert rows == [[1.0, 2.0], [3.0, 4.0]]
+
+    def test_iterator_one_hot(self, csv_file):
+        it = RecordReaderDataSetIterator(
+            CSVRecordReader(csv_file), batch_size=2, num_possible_labels=3
+        )
+        batches = list(it)
+        assert [b.num_examples() for b in batches] == [2, 2, 1]
+        assert batches[0].features.shape == (2, 4)
+        assert batches[0].labels.shape == (2, 3)
+        assert batches[0].labels[0].tolist() == [1.0, 0.0, 0.0]
+        assert batches[2].labels[0].tolist() == [0.0, 1.0, 0.0]
+
+    def test_iterator_reset(self, csv_file):
+        it = RecordReaderDataSetIterator(CSVRecordReader(csv_file), 5,
+                                         num_possible_labels=3)
+        a = it.next()
+        it.reset()
+        b = it.next()
+        np.testing.assert_array_equal(a.features, b.features)
+
+    def test_has_next_idempotent(self, csv_file):
+        it = RecordReaderDataSetIterator(CSVRecordReader(csv_file), 2,
+                                         num_possible_labels=3)
+        it.reset()
+        assert it.has_next() and it.has_next() and it.has_next()
+        total = sum(b.num_examples() for b in iter(it))
+        assert total == 5
+
+    def test_regression_labels(self, csv_file):
+        it = RecordReaderDataSetIterator(CSVRecordReader(csv_file), 5)
+        ds = it.next()
+        assert ds.labels.shape == (5, 1)
+        assert ds.labels[2, 0] == 2.0
+
+    def test_label_index_first_column(self, tmp_path):
+        p = tmp_path / "lf.csv"
+        p.write_text("1,10,20\n0,30,40\n")
+        it = RecordReaderDataSetIterator(CSVRecordReader(str(p)), 2,
+                                         label_index=0, num_possible_labels=2)
+        ds = it.next()
+        assert ds.features.tolist() == [[10.0, 20.0], [30.0, 40.0]]
+        assert ds.labels.tolist() == [[0.0, 1.0], [1.0, 0.0]]
+
+
+class TestSVMLight:
+    def test_sparse_parse(self, tmp_path):
+        p = tmp_path / "d.svm"
+        p.write_text("1 1:0.5 3:2.0\n0 2:1.0 # comment\n")
+        rows = list(SVMLightRecordReader(str(p), num_features=3))
+        assert rows[0] == [0.5, 0.0, 2.0, 1.0]
+        assert rows[1] == [0.0, 1.0, 0.0, 0.0]
+
+    def test_through_iterator(self, tmp_path):
+        p = tmp_path / "d.svm"
+        p.write_text("1 1:1.0\n0 2:1.0\n1 1:2.0\n")
+        it = RecordReaderDataSetIterator(
+            SVMLightRecordReader(str(p), 2), 3, num_possible_labels=2
+        )
+        ds = it.next()
+        assert ds.features.shape == (3, 2)
+        assert ds.labels.argmax(1).tolist() == [1, 0, 1]
+
+
+class TestImages:
+    def test_pgm_binary_round(self, tmp_path):
+        img = (np.arange(12, dtype=np.uint8).reshape(3, 4) * 20)
+        p = tmp_path / "img.pgm"
+        with open(p, "wb") as f:
+            f.write(b"P5\n# comment\n4 3\n255\n")
+            f.write(img.tobytes())
+        arr = read_pnm(str(p))
+        assert arr.shape == (3, 4)
+        np.testing.assert_allclose(arr, img / 255.0, atol=1e-6)
+
+    def test_ppm_ascii(self, tmp_path):
+        p = tmp_path / "img.ppm"
+        p.write_text("P3\n2 1\n255\n255 0 0  0 255 0\n")
+        arr = read_pnm(str(p))
+        assert arr.shape == (1, 2, 3)
+        assert arr[0, 0].tolist() == [1.0, 0.0, 0.0]
+
+    def test_npy(self, tmp_path):
+        a = np.random.rand(5, 5).astype(np.float32)
+        p = tmp_path / "a.npy"
+        np.save(p, a)
+        np.testing.assert_array_equal(load_image(str(p)), a)
+
+    def test_unsupported_format(self, tmp_path):
+        p = tmp_path / "img.png"
+        p.write_bytes(b"\x89PNG")
+        with pytest.raises(ValueError):
+            load_image(str(p))
+
+    def test_image_record_reader_directory_tree(self, tmp_path):
+        for label in ["alice", "bob"]:
+            d = tmp_path / label
+            d.mkdir()
+            for i in range(2):
+                np.save(d / f"{i}.npy",
+                        np.full((4, 4), 0.5 if label == "alice" else 0.9,
+                                np.float32))
+        reader = ImageRecordReader(str(tmp_path), width=2, height=2)
+        rows = list(reader)
+        assert reader.labels == ["alice", "bob"]
+        assert len(rows) == 4
+        assert len(rows[0]) == 5  # 2*2 pixels + label
+        assert rows[0][-1] == 0.0 and rows[-1][-1] == 1.0
+
+    def test_lfw_synthetic_fetcher(self):
+        from deeplearning4j_tpu.datasets.impl import LFWDataSetIterator
+
+        it = LFWDataSetIterator(batch=16, num_examples=48)
+        ds = it.next()
+        assert ds.features.shape == (16, 28 * 28)
+        assert ds.labels.shape == (16, 5)
+        total = 16 + sum(b.num_examples() for b in [it.next(), it.next()])
+        assert total == 48
+
+
+class TestListString:
+    def test_in_memory(self):
+        it = RecordReaderDataSetIterator(
+            ListStringRecordReader([[1, 2, 0], [3, 4, 1]]), 2,
+            num_possible_labels=2,
+        )
+        ds = it.next()
+        assert ds.features.tolist() == [[1.0, 2.0], [3.0, 4.0]]

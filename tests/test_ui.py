@@ -1,0 +1,79 @@
+"""UI server tests (ref: deeplearning4j-ui resources — nearest neighbours,
+tsne coords, weights)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ui import UiServer
+
+
+@pytest.fixture
+def server(tmp_path):
+    s = UiServer(artifact_dir=str(tmp_path))
+    (tmp_path / "w.svg").write_text("<svg></svg>")
+    words = ["king", "queen", "apple", "banana"]
+    vecs = np.array([[1, 0.9, 0], [0.9, 1, 0], [0, 0, 1], [0, 0.1, 1]], float)
+    s.upload_word_vectors(words, vecs)
+    s.upload_tsne(np.array([[0.0, 1.0], [1.0, 0.0]]), ["a", "b"])
+    s.upload_weight_histograms({"layer0_W": {"counts": [1, 2]}})
+    s.start(port=0)
+    yield s
+    s.stop()
+
+
+def _get(server, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{server.port}{path}") as r:
+        return r.status, r.read()
+
+
+def test_index(server):
+    status, body = _get(server, "/")
+    assert status == 200 and b"deeplearning4j-tpu" in body
+
+
+def test_words_endpoint(server):
+    status, body = _get(server, "/api/words")
+    data = json.loads(body)
+    assert data["count"] == 4 and "king" in data["words"]
+
+
+def test_nearest_neighbours(server):
+    _, body = _get(server, "/api/nearest?word=king&n=2")
+    data = json.loads(body)
+    names = [h["word"] for h in data["neighbours"]]
+    assert names[0] == "queen"
+    assert "king" not in names
+
+
+def test_nearest_unknown_word(server):
+    _, body = _get(server, "/api/nearest?word=zzz")
+    assert json.loads(body)["neighbours"] == []
+
+
+def test_tsne_and_weights(server):
+    _, body = _get(server, "/api/tsne")
+    assert json.loads(body)["labels"] == ["a", "b"]
+    _, body = _get(server, "/api/weights")
+    assert "layer0_W" in json.loads(body)
+
+
+def test_artifact_listing_and_file(server):
+    _, body = _get(server, "/artifacts/")
+    assert b"w.svg" in body
+    status, body = _get(server, "/artifacts/w.svg")
+    assert status == 200 and body == b"<svg></svg>"
+
+
+def test_artifact_traversal_blocked(server):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(server, "/artifacts/../../etc/passwd")
+    assert e.value.code == 404
+
+
+def test_unknown_route_404(server):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(server, "/nope")
+    assert e.value.code == 404
