@@ -70,8 +70,20 @@ def test(args) -> int:
     net = _load_model(args.conf, args.model)
     it = _make_iterator(args.input, args.batch, args.labels,
                         args.features, args.label_index)
-    ev = Evaluation()
     it.reset()
+    if args.labels is None:
+        # regression: report MSE/MAE (argmax-based Evaluation on a single
+        # label column would always claim 100% accuracy)
+        sq = ab = n = 0.0
+        while it.has_next():
+            ds = it.next()
+            err = np.asarray(net.output(ds.features)) - ds.labels
+            sq += float((err ** 2).sum())
+            ab += float(np.abs(err).sum())
+            n += err.size
+        print(f"MSE: {sq / max(n, 1):.6f}\nMAE: {ab / max(n, 1):.6f}")
+        return 0
+    ev = Evaluation()
     while it.has_next():
         ds = it.next()
         ev.eval(ds.labels, np.asarray(net.output(ds.features)))
@@ -87,8 +99,12 @@ def predict(args) -> int:
     it.reset()
     while it.has_next():
         ds = it.next()
-        preds = net.predict(ds.features)
-        rows.extend(str(int(p)) for p in preds)
+        if args.labels is None:  # regression: raw outputs, not class ids
+            out = np.asarray(net.output(ds.features))
+            rows.extend(",".join(f"{v:.6f}" for v in row) for row in out)
+        else:
+            preds = net.predict(ds.features)
+            rows.extend(str(int(p)) for p in preds)
     out = "\n".join(rows) + "\n"
     if args.output:
         with open(args.output, "w", encoding="utf-8") as f:

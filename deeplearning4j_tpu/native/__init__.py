@@ -1,0 +1,15 @@
+"""ctypes bindings for the native runtime (native/dataloader.cpp).
+
+The shared library is built on first use with the repo Makefile (g++); if no
+toolchain or build failure, every entry point falls back to the pure-python
+path so the framework stays importable anywhere.
+"""
+
+from deeplearning4j_tpu.native.lib import (
+    NativeCSVLoader,
+    BufferPool,
+    load_csv,
+    native_available,
+)
+
+__all__ = ["NativeCSVLoader", "BufferPool", "load_csv", "native_available"]

@@ -106,13 +106,18 @@ class UiServer:
                     except ValueError:
                         self._json({"error": "n must be an integer"}, 400)
                         return
+                    if n < 1:
+                        self._json({"error": "n must be >= 1"}, 400)
+                        return
                     self._json({"word": word, "neighbours": ui.nearest(word, n)})
                 elif url.path == "/api/tsne":
                     self._json(ui._tsne or {})
                 elif url.path == "/api/weights":
                     self._json(ui._weights or {})
                 elif url.path.startswith("/artifacts/") and ui.artifact_dir:
-                    rel = url.path[len("/artifacts/"):]
+                    from urllib.parse import unquote
+
+                    rel = unquote(url.path[len("/artifacts/"):])
                     base = os.path.realpath(ui.artifact_dir)
                     if not os.path.isdir(base):
                         self._json({"error": "artifact dir missing"}, 404)

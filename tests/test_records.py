@@ -18,7 +18,7 @@ from deeplearning4j_tpu.datasets.records import (
 @pytest.fixture
 def csv_file(tmp_path):
     p = tmp_path / "data.csv"
-    p.write_text("# header\n" if False else "5.1,3.5,1.4,0.2,0\n"
+    p.write_text("5.1,3.5,1.4,0.2,0\n"
                  "4.9,3.0,1.4,0.2,0\n"
                  "6.3,3.3,6.0,2.5,2\n"
                  "5.8,2.7,5.1,1.9,2\n"
