@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.params import BIAS_KEY, WEIGHT_KEY
 from deeplearning4j_tpu.ops.activations import activation
+from deeplearning4j_tpu.ops.pallas_kernels import _FUSABLE, fused_dense
 
 
 _DROP_CONNECT_KEEP = 0.5  # ref BaseLayer drop-connect keeps weights w.p. 0.5
@@ -60,5 +61,10 @@ def forward(
     if key is not None:
         kdrop, kdc = jax.random.split(key)
     x = apply_dropout(x, conf.dropout, train, kdrop)
+    # fused matmul+bias+activation kernel for the plain path; the masked
+    # (drop-connect) pre_output variant keeps the unfused route
+    if not (drop_connect and train) and conf.activation_function in _FUSABLE:
+        return fused_dense(x, params[WEIGHT_KEY], params[BIAS_KEY],
+                           conf.activation_function)
     pre = pre_output(conf, params, x, train=train, key=kdc, drop_connect=drop_connect)
     return activation(conf.activation_function)(pre)

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.ops.pallas_kernels import lstm_gates
 from deeplearning4j_tpu.nn.params import (
     DECODER_BIAS_KEY,
     DECODER_WEIGHT_KEY,
@@ -43,12 +44,8 @@ def hidden_sequence(
         h_prev, c_prev = carry
         h_in = jnp.concatenate([ones, x_t, h_prev], axis=-1)
         gates = h_in @ w
-        i = jax.nn.sigmoid(gates[:, :hidden])
-        f = jax.nn.sigmoid(gates[:, hidden : 2 * hidden])
-        o = jax.nn.sigmoid(gates[:, 2 * hidden : 3 * hidden])
-        g = jnp.tanh(gates[:, 3 * hidden :])
-        c = f * c_prev + i * g
-        h = o * jnp.tanh(c)
+        # fused i/f/o/g cell kernel (pallas on TPU, lax elsewhere)
+        c, h = lstm_gates(gates, c_prev)
         return (h, c), h
 
     zeros = jnp.zeros((batch, hidden), x.dtype)

@@ -1,0 +1,169 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has no long-context mechanism at all (SURVEY.md §2.5: LSTM
+materializes whole sequences in Java, no attention anywhere). These are the
+TPU-native long-context primitives the rebuild adds as first-class citizens:
+
+- ``ring_attention``: each device holds one sequence shard of Q/K/V; K/V
+  blocks rotate around the ring via ``ppermute`` (ICI neighbor exchange)
+  while a streaming online-softmax accumulates the output — memory per
+  device stays O(T/P), communication overlaps block compute.
+- ``ulysses_attention``: all-to-all swaps the sharded axis from sequence to
+  heads, computes full-sequence attention locally on H/P heads, swaps back —
+  cheaper at moderate sequence lengths when H divides the mesh axis.
+
+Both run under ``shard_map`` over a named mesh axis and are validated on the
+8-device CPU mesh in tests (the driver dry-runs the same path).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Array = jax.Array
+
+_NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, bias):
+    """Scores for one (q-block, k-block) pair: returns (scores_max,
+    exp-normalized partials). q: (B,H,Tq,D), k/v: (B,H,Tk,D)."""
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(q.shape[-1] * 1.0)
+    if bias is not None:
+        scores = scores + bias
+    m = scores.max(axis=-1)  # (B,H,Tq)
+    p = jnp.exp(scores - m[..., None])
+    pv = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return m, p.sum(-1), pv
+
+
+def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool):
+    """Per-device body under shard_map. q/k/v: (B, H, T_local, D)."""
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    t_local = q.shape[2]
+
+    def body(step, carry):
+        o, l, m, k_cur, v_cur = carry
+        # k_cur originated on device (my_idx - step) mod P
+        src = (my_idx - step) % axis_size
+
+        def attend(o, l, m):
+            if causal:
+                q_pos = my_idx * t_local + jnp.arange(t_local)  # (Tq,)
+                k_pos = src * t_local + jnp.arange(t_local)  # (Tk,)
+                mask = q_pos[:, None] >= k_pos[None, :]
+                bias = jnp.where(mask, 0.0, _NEG_INF)[None, None]
+            else:
+                bias = None
+            bm, bl, bo = _block_attn(q, k_cur, v_cur, bias)
+            # online softmax merge
+            new_m = jnp.maximum(m, bm)
+            scale_old = jnp.exp(m - new_m)
+            scale_new = jnp.exp(bm - new_m)
+            new_o = o * scale_old[..., None] + bo * scale_new[..., None]
+            new_l = l * scale_old + bl * scale_new
+            return new_o, new_l, new_m
+
+        if causal:
+            # K blocks from strictly-later devices are fully masked — skip
+            # both einsums (roughly half of all (device, step) pairs)
+            o, l, m = jax.lax.cond(
+                src <= my_idx, attend, lambda o, l, m: (o, l, m), o, l, m
+            )
+        else:
+            o, l, m = attend(o, l, m)
+        # rotate K/V one step around the ring (device i -> i+1)
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return o, l, m, k_nxt, v_nxt
+
+    o0 = jnp.zeros_like(q)
+    l0 = jnp.zeros(q.shape[:3], q.dtype)
+    m0 = jnp.full(q.shape[:3], _NEG_INF, q.dtype)
+    o, l, m, _, _ = jax.lax.fori_loop(0, axis_size, body, (o0, l0, m0, k, v))
+    # fully-masked rows (can't happen with causal self-attention, where
+    # position t always sees itself) would have l == 0; guard anyway
+    return o / jnp.maximum(l, 1e-30)[..., None]
+
+
+def ring_attention(q: Array, k: Array, v: Array, mesh: Mesh, axis: str,
+                   causal: bool = False) -> Array:
+    """Multi-head attention with the SEQUENCE axis sharded over ``axis``.
+
+    q/k/v: (B, H, T, D) global arrays (T divisible by the axis size).
+    Returns (B, H, T, D) with the same sharding.
+    """
+    spec = P(None, None, axis, None)
+    fn = partial(_ring_attention_sharded, axis_name=axis, causal=causal)
+    sharded = jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    return sharded(q, k, v)
+
+
+def _ulysses_sharded(q, k, v, axis_name: str, causal: bool):
+    """all-to-all: (B, H, T/P, D) -> (B, H/P, T, D), full local attention,
+    then back. Requires H % P == 0."""
+    p_size = jax.lax.psum(1, axis_name)
+    # split heads across devices, gather the full sequence
+    def seq_to_heads(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    t = qh.shape[2]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / jnp.sqrt(qh.shape[-1] * 1.0)
+    if causal:
+        mask = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    attn = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", attn, vh)
+    return heads_to_seq(out)
+
+
+def ulysses_attention(q: Array, k: Array, v: Array, mesh: Mesh, axis: str,
+                      causal: bool = False) -> Array:
+    """DeepSpeed-Ulysses-style sequence parallelism: all-to-all to head
+    sharding, dense local attention, all-to-all back. H must be divisible by
+    the axis size."""
+    axis_size = mesh.shape[axis]
+    if q.shape[1] % axis_size != 0:
+        raise ValueError(
+            f"ulysses needs heads ({q.shape[1]}) divisible by axis size "
+            f"({axis_size}); use ring_attention instead"
+        )
+    spec = P(None, None, axis, None)
+    fn = partial(_ulysses_sharded, axis_name=axis, causal=causal)
+    sharded = jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    return sharded(q, k, v)
+
+
+def reference_attention(q: Array, k: Array, v: Array,
+                        causal: bool = False) -> Array:
+    """Unsharded dense attention for verification."""
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(q.shape[-1] * 1.0)
+    if causal:
+        t = q.shape[2]
+        mask = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, -1), v)
+
+
+def sequence_sharding(mesh: Mesh, axis: str) -> NamedSharding:
+    """NamedSharding placing the sequence axis of (B,H,T,D) on ``axis``."""
+    return NamedSharding(mesh, P(None, None, axis, None))

@@ -1,0 +1,104 @@
+"""Sequence-parallel attention tests on the 8-device CPU mesh
+(conftest forces XLA_FLAGS=--xla_force_host_platform_device_count=8)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.parallel.ring_attention import (
+    reference_attention,
+    ring_attention,
+    sequence_sharding,
+    ulysses_attention,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devs = np.array(jax.devices()[:8])
+    return Mesh(devs, ("sp",))
+
+
+def _qkv(b=2, h=4, t=64, d=16, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (b, h, t, d)
+    return tuple(jax.random.normal(k, shape, jnp.float32) * 0.5 for k in ks)
+
+
+def test_ring_matches_dense(mesh):
+    q, k, v = _qkv()
+    out = ring_attention(q, k, v, mesh, "sp", causal=False)
+    ref = reference_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_causal_matches_dense(mesh):
+    q, k, v = _qkv(seed=1)
+    out = ring_attention(q, k, v, mesh, "sp", causal=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_with_sharded_inputs(mesh):
+    """Inputs already device_put with the sequence sharding: stays sharded."""
+    q, k, v = _qkv(seed=2)
+    sh = sequence_sharding(mesh, "sp")
+    qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+    out = jax.jit(lambda a, b, c: ring_attention(a, b, c, mesh, "sp", True))(
+        qs, ks, vs
+    )
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_grad_flows(mesh):
+    q, k, v = _qkv(b=1, h=2, t=32, d=8, seed=3)
+
+    def loss(q, k, v):
+        return ring_attention(q, k, v, mesh, "sp", causal=True).sum()
+
+    def ref_loss(q, k, v):
+        return reference_attention(q, k, v, causal=True).sum()
+
+    g = jax.grad(loss)(q, k, v)
+    gr = jax.grad(ref_loss)(q, k, v)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
+                               atol=5e-5, rtol=5e-5)
+
+
+def test_ulysses_matches_dense(mesh):
+    q, k, v = _qkv(h=8, seed=4)  # 8 heads over 8 devices
+    out = ulysses_attention(q, k, v, mesh, "sp", causal=False)
+    ref = reference_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_causal_matches_dense(mesh):
+    q, k, v = _qkv(h=8, seed=5)
+    out = ulysses_attention(q, k, v, mesh, "sp", causal=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_heads(mesh):
+    q, k, v = _qkv(h=4)  # 4 heads, 8 devices
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_attention(q, k, v, mesh, "sp")
+
+
+def test_long_sequence_memory_shape(mesh):
+    """T=1024 over 8 devices: per-device block is 128 — just verify it runs
+    and matches on a slice (full dense ref is still fine at this size)."""
+    q, k, v = _qkv(b=1, h=2, t=1024, d=8, seed=6)
+    out = ring_attention(q, k, v, mesh, "sp", causal=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out)[0, 0, ::101],
+                               np.asarray(ref)[0, 0, ::101],
+                               atol=5e-5, rtol=5e-5)
