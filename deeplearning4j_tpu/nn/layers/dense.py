@@ -61,9 +61,14 @@ def forward(
     if key is not None:
         kdrop, kdc = jax.random.split(key)
     x = apply_dropout(x, conf.dropout, train, kdrop)
-    # fused matmul+bias+activation kernel for the plain path; the masked
-    # (drop-connect) pre_output variant keeps the unfused route
-    if not (drop_connect and train) and conf.activation_function in _FUSABLE:
+    # fused matmul+bias+activation kernel for the plain single-device path;
+    # multi-device sessions keep the unfused route — pallas_call is not
+    # GSPMD-partitionable, so under a tp mesh it would all-gather the
+    # Megatron-sharded weight and drop the model-axis output sharding.
+    # The masked (drop-connect) pre_output variant is also unfused.
+    if (not (drop_connect and train)
+            and conf.activation_function in _FUSABLE
+            and jax.device_count() == 1):
         return fused_dense(x, params[WEIGHT_KEY], params[BIAS_KEY],
                            conf.activation_function)
     pre = pre_output(conf, params, x, train=train, key=kdc, drop_connect=drop_connect)

@@ -110,11 +110,11 @@ def fused_dense(x: Array, w: Array, b: Array, activation: str = "linear"):
 
 def _fused_dense_fwd(x, w, b, activation):
     out = fused_dense(x, w, b, activation)
-    return out, (x, w, b, out)
+    return out, (x, w, out)
 
 
 def _fused_dense_bwd(activation, res, g):
-    x, w, b, out = res
+    x, w, out = res
     d = g * _derivative(activation, out)
     return d @ w.T, x.T @ d, d.sum(0)
 

@@ -78,10 +78,17 @@ def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool):
             )
         else:
             o, l, m = attend(o, l, m)
-        # rotate K/V one step around the ring (device i -> i+1)
-        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        # rotate K/V one step around the ring (device i -> i+1); the last
+        # step's blocks are never attended to, so skip that exchange
+        def rotate(kv):
+            k_c, v_c = kv
+            perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+            return (jax.lax.ppermute(k_c, axis_name, perm),
+                    jax.lax.ppermute(v_c, axis_name, perm))
+
+        k_nxt, v_nxt = jax.lax.cond(
+            step < axis_size - 1, rotate, lambda kv: kv, (k_cur, v_cur)
+        )
         return o, l, m, k_nxt, v_nxt
 
     o0 = jnp.zeros_like(q)
@@ -112,7 +119,6 @@ def ring_attention(q: Array, k: Array, v: Array, mesh: Mesh, axis: str,
 def _ulysses_sharded(q, k, v, axis_name: str, causal: bool):
     """all-to-all: (B, H, T/P, D) -> (B, H/P, T, D), full local attention,
     then back. Requires H % P == 0."""
-    p_size = jax.lax.psum(1, axis_name)
     # split heads across devices, gather the full sequence
     def seq_to_heads(x):
         return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
@@ -123,13 +129,7 @@ def _ulysses_sharded(q, k, v, axis_name: str, causal: bool):
                                   tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    t = qh.shape[2]
-    scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / jnp.sqrt(qh.shape[-1] * 1.0)
-    if causal:
-        mask = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]
-        scores = jnp.where(mask[None, None], scores, _NEG_INF)
-    attn = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhqk,bhkd->bhqd", attn, vh)
+    out = reference_attention(qh, kh, vh, causal=causal)
     return heads_to_seq(out)
 
 

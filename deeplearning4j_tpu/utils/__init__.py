@@ -1,0 +1,39 @@
+"""Utility subsystem.
+
+Parity with ref util/ (MathUtils, Viterbi, MovingWindowMatrix,
+DiskBasedQueue) and the vendored berkeley/ NLP utilities (Counter,
+CounterMap). Only the surface other components and user code actually
+exercise is reproduced; pure-Java plumbing with no TPU relevance
+(Dl4jReflection, StringGrid dedup, …) is intentionally out of scope.
+"""
+
+from deeplearning4j_tpu.utils.counter import Counter, CounterMap
+from deeplearning4j_tpu.utils.disk_queue import DiskBasedQueue
+from deeplearning4j_tpu.utils.math_utils import (
+    clamp,
+    entropy,
+    information_gain,
+    normalize_to_range,
+    rounded,
+    sigmoid,
+    sum_of_squares,
+    uniform,
+)
+from deeplearning4j_tpu.utils.moving_window import MovingWindowMatrix
+from deeplearning4j_tpu.utils.viterbi import Viterbi
+
+__all__ = [
+    "Counter",
+    "CounterMap",
+    "DiskBasedQueue",
+    "MovingWindowMatrix",
+    "Viterbi",
+    "clamp",
+    "entropy",
+    "information_gain",
+    "normalize_to_range",
+    "rounded",
+    "sigmoid",
+    "sum_of_squares",
+    "uniform",
+]

@@ -1,0 +1,140 @@
+"""Utility tests (ref: MathUtilsTest, ViterbiTest, berkeley Counter usage)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.utils import (
+    Counter,
+    CounterMap,
+    DiskBasedQueue,
+    MovingWindowMatrix,
+    Viterbi,
+    clamp,
+    entropy,
+    information_gain,
+    normalize_to_range,
+    sum_of_squares,
+)
+
+
+class TestViterbi:
+    def test_emission_only_argmax(self):
+        em = np.log(np.array([[0.9, 0.1], [0.2, 0.8], [0.7, 0.3]]))
+        path, score = Viterbi(2).decode(em)
+        assert path.tolist() == [0, 1, 0]
+        assert score == pytest.approx(np.log(0.9) + np.log(0.8) + np.log(0.7))
+
+    def test_transitions_enforce_smoothness(self):
+        # sticky transitions flip the middle step despite its emission
+        em = np.log(np.array([[0.9, 0.1], [0.45, 0.55], [0.9, 0.1]]))
+        sticky = np.log(np.array([[0.95, 0.05], [0.05, 0.95]]))
+        path, _ = Viterbi(2, transitions=sticky).decode(em)
+        assert path.tolist() == [0, 0, 0]
+
+    def test_shape_validation(self):
+        with pytest.raises(ValueError):
+            Viterbi(3).decode(np.zeros((4, 2)))
+        with pytest.raises(ValueError):
+            Viterbi(2, transitions=np.zeros((3, 3)))
+
+
+class TestCounter:
+    def test_basic_counts(self):
+        c = Counter()
+        for w in ["a", "b", "a", "c", "a"]:
+            c.increment_count(w)
+        assert c.get_count("a") == 3.0
+        assert c.arg_max() == "a"
+        assert c.total_count() == 5.0
+        assert c.sorted_keys()[0] == "a"
+
+    def test_normalize(self):
+        c = Counter()
+        c.increment_count("x", 3)
+        c.increment_count("y", 1)
+        c.normalize()
+        assert c.get_count("x") == pytest.approx(0.75)
+        assert c.total_count() == pytest.approx(1.0)
+
+    def test_empty_argmax_raises(self):
+        with pytest.raises(ValueError):
+            Counter().arg_max()
+
+    def test_counter_map(self):
+        cm = CounterMap()
+        cm.increment_count("the", "cat", 2)
+        cm.increment_count("the", "dog", 1)
+        cm.increment_count("a", "cat", 1)
+        assert cm.get_count("the", "cat") == 2.0
+        assert cm.get_count("nope", "cat") == 0.0
+        assert cm.total_count() == 4.0
+        assert cm.total_size() == 3
+        assert cm.get_counter("the").arg_max() == "cat"
+
+
+class TestMathUtils:
+    def test_entropy(self):
+        assert entropy([0.5, 0.5]) == pytest.approx(np.log(2))
+        assert entropy([1.0, 0.0]) == 0.0
+
+    def test_information_gain_perfect_split(self):
+        gain = information_gain([5, 5], [[5, 0], [0, 5]])
+        assert gain == pytest.approx(np.log(2))
+
+    def test_normalize_to_range(self):
+        out = normalize_to_range([0, 5, 10], 0, 1)
+        assert out.tolist() == [0.0, 0.5, 1.0]
+        assert normalize_to_range([3, 3]).tolist() == [0.0, 0.0]
+
+    def test_clamp_and_sos(self):
+        assert clamp(5, 0, 3) == 3
+        assert clamp(-1, 0, 3) == 0
+        assert sum_of_squares([3, 4]) == 25.0
+
+
+class TestMovingWindowMatrix:
+    def test_window_count_and_content(self):
+        m = np.arange(16).reshape(4, 4)
+        w = MovingWindowMatrix(m, 2, 2).windows()
+        assert len(w) == 9
+        np.testing.assert_array_equal(w[0], [[0, 1], [4, 5]])
+        np.testing.assert_array_equal(w[-1], [[10, 11], [14, 15]])
+
+    def test_rotations(self):
+        m = np.arange(4).reshape(2, 2)
+        w = MovingWindowMatrix(m, 2, 2, add_rotate=True).windows()
+        assert len(w) == 4  # original + 3 rotations
+        np.testing.assert_array_equal(w[1], np.rot90(m))
+
+    def test_oversized_window_rejected(self):
+        with pytest.raises(ValueError):
+            MovingWindowMatrix(np.zeros((2, 2)), 3, 1)
+
+
+class TestDiskBasedQueue:
+    def test_fifo_round_trip(self, tmp_path):
+        q = DiskBasedQueue(str(tmp_path / "spool"))
+        q.add({"a": 1})
+        q.add([1, 2, 3])
+        assert len(q) == 2
+        assert q.peek() == {"a": 1}
+        assert q.poll() == {"a": 1}
+        assert q.poll() == [1, 2, 3]
+        assert q.poll() is None
+        assert q.is_empty()
+
+    def test_items_survive_on_disk(self, tmp_path):
+        spool = str(tmp_path / "spool")
+        q = DiskBasedQueue(spool)
+        q.add(np.arange(5))
+        import os
+        assert len(os.listdir(spool)) == 1
+        np.testing.assert_array_equal(q.poll(), np.arange(5))
+        assert os.listdir(spool) == []
+
+    def test_clear(self, tmp_path):
+        q = DiskBasedQueue(str(tmp_path / "s"))
+        for i in range(5):
+            q.add(i)
+        q.clear()
+        assert q.is_empty()
