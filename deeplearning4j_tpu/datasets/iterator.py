@@ -194,3 +194,83 @@ class MultipleEpochsIterator(DataSetIterator):
 
     def total_outcomes(self) -> int:
         return self.underlying.total_outcomes()
+
+
+class ReconstructionDataSetIterator(DataSetIterator):
+    """Labels replaced by the features themselves — autoencoder targets
+    (ref: datasets/iterator/ReconstructionDataSetIterator.java)."""
+
+    def __init__(self, backing: DataSetIterator):
+        self.backing = backing
+
+    def has_next(self) -> bool:
+        return self.backing.has_next()
+
+    def next(self, num: Optional[int] = None) -> DataSet:
+        ds = self.backing.next(num)
+        return DataSet(ds.features, ds.features)
+
+    def reset(self) -> None:
+        self.backing.reset()
+
+    def batch(self) -> int:
+        return self.backing.batch()
+
+    def total_examples(self) -> int:
+        return self.backing.total_examples()
+
+    def input_columns(self) -> int:
+        return self.backing.input_columns()
+
+    def total_outcomes(self) -> int:
+        return self.backing.input_columns()
+
+
+class MovingWindowDataSetIterator(DataSetIterator):
+    """Batches of sliding windows over a (rows, cols) matrix, each window
+    flattened (ref: datasets/iterator/MovingWindowBaseDataSetIterator +
+    util/MovingWindowMatrix)."""
+
+    def __init__(self, batch_size: int, data, labels, window_rows: int,
+                 window_cols: int):
+        import numpy as _np
+
+        from deeplearning4j_tpu.utils.moving_window import MovingWindowMatrix
+
+        data = _np.asarray(data)
+        windows = MovingWindowMatrix(data, window_rows, window_cols).windows()
+        feats = _np.stack([w.ravel() for w in windows]).astype(_np.float32)
+        labels = _np.asarray(labels, _np.float32)
+        if labels.ndim == 1:
+            labels = labels[None, :]
+        # every window comes from the same source matrix, so either one label
+        # row (broadcast to all windows) or one per window is meaningful
+        if len(labels) == 1:
+            labels = _np.repeat(labels, len(feats), axis=0)
+        elif len(labels) != len(feats):
+            raise ValueError(
+                f"labels must have 1 row or one per window ({len(feats)}), "
+                f"got {len(labels)}"
+            )
+        self._inner = ListDataSetIterator(DataSet(feats, labels), batch_size)
+
+    def has_next(self) -> bool:
+        return self._inner.has_next()
+
+    def next(self, num: Optional[int] = None) -> DataSet:
+        return self._inner.next(num)
+
+    def reset(self) -> None:
+        self._inner.reset()
+
+    def batch(self) -> int:
+        return self._inner.batch()
+
+    def total_examples(self) -> int:
+        return self._inner.total_examples()
+
+    def input_columns(self) -> int:
+        return self._inner.input_columns()
+
+    def total_outcomes(self) -> int:
+        return self._inner.total_outcomes()

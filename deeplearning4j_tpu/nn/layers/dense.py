@@ -16,7 +16,11 @@ import jax.numpy as jnp
 from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.params import BIAS_KEY, WEIGHT_KEY
 from deeplearning4j_tpu.ops.activations import activation
-from deeplearning4j_tpu.ops.pallas_kernels import _FUSABLE, fused_dense
+from deeplearning4j_tpu.ops.pallas_kernels import (
+    _FUSABLE,
+    fused_dense,
+    use_fused_dense,
+)
 
 
 _DROP_CONNECT_KEEP = 0.5  # ref BaseLayer drop-connect keeps weights w.p. 0.5
@@ -61,14 +65,12 @@ def forward(
     if key is not None:
         kdrop, kdc = jax.random.split(key)
     x = apply_dropout(x, conf.dropout, train, kdrop)
-    # fused matmul+bias+activation kernel for the plain single-device path;
-    # multi-device sessions keep the unfused route — pallas_call is not
-    # GSPMD-partitionable, so under a tp mesh it would all-gather the
-    # Megatron-sharded weight and drop the model-axis output sharding.
-    # The masked (drop-connect) pre_output variant is also unfused.
+    # fused matmul+bias+activation kernel when enabled (see
+    # pallas_kernels.use_fused_dense for the sharding rationale); the masked
+    # (drop-connect) pre_output variant keeps the unfused route
     if (not (drop_connect and train)
             and conf.activation_function in _FUSABLE
-            and jax.device_count() == 1):
+            and use_fused_dense()):
         return fused_dense(x, params[WEIGHT_KEY], params[BIAS_KEY],
                            conf.activation_function)
     pre = pre_output(conf, params, x, train=train, key=kdc, drop_connect=drop_connect)

@@ -45,6 +45,25 @@ def _interpret() -> bool:
     return not _on_tpu()
 
 
+# Fused-dense layer gating. pallas_call is not GSPMD-partitionable: under a
+# tensor-parallel mesh it would all-gather Megatron-sharded weights and drop
+# the output sharding, so the auto default only engages on single-device
+# sessions. ``set_fused_dense(True/False)`` overrides (e.g. force-on for a
+# single-logical-device program on a multi-chip host, or in tests).
+_fused_dense_override: "bool | None" = None
+
+
+def set_fused_dense(enabled: "bool | None") -> None:
+    global _fused_dense_override
+    _fused_dense_override = enabled
+
+
+def use_fused_dense() -> bool:
+    if _fused_dense_override is not None:
+        return _fused_dense_override
+    return jax.device_count() == 1
+
+
 def _cdiv(a: int, b: int) -> int:
     return -(-a // b)
 

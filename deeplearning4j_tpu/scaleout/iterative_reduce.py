@@ -111,14 +111,13 @@ class NetworkWorker(ComputableWorker[np.ndarray]):
     superstep, emit the resulting flat params; absorb averaged params."""
 
     def __init__(self, conf, features: np.ndarray, labels: np.ndarray,
-                 batches_per_superstep: int = 1, supersteps: int = 1):
+                 supersteps: int = 1):
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
         self.net = MultiLayerNetwork(conf).init()
         self.features = features
         self.labels = labels
         self.remaining = supersteps
-        self.batches_per_superstep = batches_per_superstep
 
     def compute(self) -> Optional[np.ndarray]:
         if self.remaining <= 0:

@@ -34,18 +34,18 @@ class DiskBasedQueue:
             if not self._order:
                 return None
             path = self._order.popleft()
-        with open(path, "rb") as f:
-            item = pickle.load(f)
-        os.unlink(path)
-        return item
+            with open(path, "rb") as f:
+                item = pickle.load(f)
+            os.unlink(path)
+            return item
 
     def peek(self) -> Optional[Any]:
+        # read under the lock: a concurrent poll() may unlink the head file
         with self._lock:
             if not self._order:
                 return None
-            path = self._order[0]
-        with open(path, "rb") as f:
-            return pickle.load(f)
+            with open(self._order[0], "rb") as f:
+                return pickle.load(f)
 
     def is_empty(self) -> bool:
         with self._lock:

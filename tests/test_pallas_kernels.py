@@ -112,3 +112,32 @@ class TestLSTMGates:
         c_final, hs = jax.lax.scan(step, jnp.zeros((b, h)), seq)
         assert hs.shape == (t, b, h)
         assert np.isfinite(np.asarray(c_final)).all()
+
+
+class TestFusedDenseLayerIntegration:
+    def test_dense_layer_routes_through_fused_kernel(self):
+        """Force-enable the fused path (tests run on an 8-device CPU
+        platform where the auto gate is off) and check the layer forward
+        matches the unfused route."""
+        import dataclasses
+
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers import dense
+        from deeplearning4j_tpu.nn.params import init_layer_params
+        from deeplearning4j_tpu.ops.pallas_kernels import set_fused_dense, use_fused_dense
+
+        conf = (NeuralNetConfiguration.Builder()
+                .n_in(128).n_out(128).activation_function("tanh")
+                .weight_init("VI").seed(0).build())
+        params = init_layer_params(jax.random.PRNGKey(0), conf)
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 128), jnp.float32)
+        assert not use_fused_dense()  # 8-device CPU platform → auto off
+        unfused = dense.forward(conf, params, x)
+        set_fused_dense(True)
+        try:
+            assert use_fused_dense()
+            fused = dense.forward(conf, params, x)
+        finally:
+            set_fused_dense(None)
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(unfused),
+                                   atol=1e-5, rtol=1e-5)

@@ -138,3 +138,68 @@ class TestDiskBasedQueue:
             q.add(i)
         q.clear()
         assert q.is_empty()
+
+
+class TestConfigurationRegistry:
+    def test_register_retrieve_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.scaleout.registry import ConfigurationRegistry
+
+        reg = ConfigurationRegistry(str(tmp_path))
+        conf = {"lr": 0.1, "layers": [4, 8, 3]}
+        reg.register("cluster1", "net-a", conf)
+        assert reg.retrieve("cluster1", "net-a") == conf
+        assert reg.retrieve("cluster1", "missing") is None
+        assert reg.list_ids("cluster1") == ["net-a"]
+        assert reg.delete("cluster1", "net-a")
+        assert not reg.delete("cluster1", "net-a")
+
+
+class TestExtraIterators:
+    def test_reconstruction_iterator(self):
+        import numpy as np
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.iterator import (
+            ListDataSetIterator,
+            ReconstructionDataSetIterator,
+        )
+
+        x = np.random.RandomState(0).rand(10, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[np.zeros(10, int)]
+        it = ReconstructionDataSetIterator(
+            ListDataSetIterator(DataSet(x, y), 5)
+        )
+        it.reset()
+        ds = it.next()
+        np.testing.assert_array_equal(ds.features, ds.labels)
+
+    def test_moving_window_iterator(self):
+        import numpy as np
+        from deeplearning4j_tpu.datasets.iterator import MovingWindowDataSetIterator
+
+        data = np.arange(16).reshape(4, 4)
+        it = MovingWindowDataSetIterator(4, data, np.array([1.0]), 2, 2)
+        it.reset()
+        ds = it.next()
+        assert ds.features.shape == (4, 4)  # 4 windows of 2x2 per batch
+        total = 4 + sum(b.num_examples() for b in [it.next(), it.next()])
+        assert total == 9
+
+    def test_registry_rejects_traversal(self, tmp_path):
+        from deeplearning4j_tpu.scaleout.registry import ConfigurationRegistry
+
+        reg = ConfigurationRegistry(str(tmp_path / "root"))
+        with pytest.raises(ValueError):
+            reg.register("..", "x", {})
+        with pytest.raises(ValueError):
+            reg.delete("ns", "..")
+
+    def test_moving_window_label_validation(self):
+        import numpy as np
+        from deeplearning4j_tpu.datasets.iterator import MovingWindowDataSetIterator
+
+        data = np.arange(16).reshape(4, 4)
+        with pytest.raises(ValueError, match="labels"):
+            MovingWindowDataSetIterator(4, data, np.ones((4, 1)), 2, 2)
+        # one label per window (9) is accepted
+        it = MovingWindowDataSetIterator(4, data, np.ones((9, 1)), 2, 2)
+        assert it.total_examples() == 9
