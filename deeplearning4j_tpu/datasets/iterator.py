@@ -242,7 +242,12 @@ class MovingWindowDataSetIterator(DataSetIterator):
         feats = _np.stack([w.ravel() for w in windows]).astype(_np.float32)
         labels = _np.asarray(labels, _np.float32)
         if labels.ndim == 1:
-            labels = labels[None, :]
+            # 1-D input: per-window scalars if the length matches the window
+            # count, otherwise a single label row shared by every window
+            if len(labels) == len(feats):
+                labels = labels[:, None]
+            else:
+                labels = labels[None, :]
         # every window comes from the same source matrix, so either one label
         # row (broadcast to all windows) or one per window is meaningful
         if len(labels) == 1:

@@ -124,6 +124,14 @@ def make_train_step(conf: MultiLayerConfiguration, donate: bool = False,
     the step; master params, updater state, and the loss stay float32.
     """
 
+    step = _raw_train_step(conf, policy)
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(step, donate_argnums=donate_argnums)
+
+
+def _raw_train_step(conf: MultiLayerConfiguration, policy=None):
+    """Unjitted step body shared by make_train_step / make_train_epoch."""
+
     def step(params, states, iteration, x, labels, key):
         kdrop, _ = jax.random.split(key)
 
@@ -148,8 +156,40 @@ def make_train_step(conf: MultiLayerConfiguration, donate: bool = False,
             new_states.append(st)
         return tuple(new_params), tuple(new_states), score
 
+    return step
+
+
+def make_train_epoch(conf: MultiLayerConfiguration, n_steps: int,
+                     donate: bool = True, policy=None):
+    """Device-resident training loop: ``lax.scan`` over ``n_steps`` batches
+    inside ONE jitted program.
+
+    epoch(params, states, iteration0, xs, ys, key)
+      -> (new_params, new_states, scores)
+
+    xs: (n_steps, batch, features), ys: (n_steps, batch, classes). Keeps the
+    loop on the TPU — one dispatch per epoch chunk instead of per step, which
+    matters when host→device dispatch latency rivals step compute (small
+    models, remote-tunnel setups). The per-step RNG key is folded from the
+    step index, matching make_train_step semantics.
+    """
+    step = _raw_train_step(conf, policy)
+
+    def epoch(params, states, iteration0, xs, ys, key):
+        def body(carry, inp):
+            params, states = carry
+            i, x, y = inp
+            sub = jax.random.fold_in(key, i)
+            params, states, score = step(params, states, iteration0 + i, x, y, sub)
+            return (params, states), score
+
+        idx = jnp.arange(n_steps)
+        (params, states), scores = jax.lax.scan(body, (params, states),
+                                                (idx, xs, ys))
+        return params, states, scores
+
     donate_argnums = (0, 1) if donate else ()
-    return jax.jit(step, donate_argnums=donate_argnums)
+    return jax.jit(epoch, donate_argnums=donate_argnums)
 
 
 def init_train_state(conf: MultiLayerConfiguration, params: NetParams):

@@ -290,12 +290,13 @@ class Solver:
             rho = ((full_score - score) / model_delta) if model_delta < 0 else 0.0
             # backtrack the CG step until the true score decreases
             # (ref StochasticHessianFree CG-backtracking)
+            # "not (new < score)" so NaN/inf scores count as failures too
             step_scale = 1.0
             new_score = full_score
-            while new_score > score and step_scale > 1e-4:
+            while not (new_score < score) and step_scale > 1e-4:
                 step_scale *= 0.5
                 new_score = float(f_flat(x + step_scale * d, sub))
-            if new_score > score:
+            if not (new_score < score):
                 lam *= 1.5  # no progress at any scale → more damping
                 continue
             if rho > 0.75:

@@ -23,13 +23,15 @@ class ConfigurationRegistry:
         self.root = root or os.path.join(tempfile.gettempdir(), "dl4j-registry")
         os.makedirs(self.root, exist_ok=True)
 
+    @staticmethod
+    def _safe_component(s: str) -> str:
+        s = s.replace("/", "_").replace("\\", "_")
+        if s in ("", ".", ".."):
+            raise ValueError(f"invalid registry path component {s!r}")
+        return s
+
     def _path(self, namespace: str, conf_id: str) -> str:
-        safe = []
-        for s in (namespace, conf_id):
-            s = s.replace("/", "_").replace("\\", "_")
-            if s in ("", ".", ".."):
-                raise ValueError(f"invalid registry path component {s!r}")
-            safe.append(s)
+        safe = [self._safe_component(s) for s in (namespace, conf_id)]
         path = os.path.join(self.root, safe[0], safe[1] + ".json")
         root = os.path.realpath(self.root)
         if not os.path.realpath(path).startswith(root + os.sep):
@@ -67,7 +69,7 @@ class ConfigurationRegistry:
         return False
 
     def list_ids(self, namespace: str) -> List[str]:
-        d = os.path.join(self.root, namespace.replace("/", "_"))
+        d = os.path.join(self.root, self._safe_component(namespace))
         if not os.path.isdir(d):
             return []
         return sorted(f[:-5] for f in os.listdir(d) if f.endswith(".json"))

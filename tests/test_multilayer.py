@@ -112,3 +112,34 @@ def test_score_decreases_with_listeners():
     net.fit(it)
     assert len(collector.scores) == 30
     assert collector.scores[-1][1] < collector.scores[0][1]
+
+
+def test_train_epoch_matches_sequential_steps():
+    """make_train_epoch (device-resident scan) == the same make_train_step
+    sequence with fold_in keys."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn import functional as F
+
+    conf = iris_mlp_conf()
+    params = F.init_params(conf, jax.random.PRNGKey(0))
+    states = F.init_train_state(conf, params)
+    step = F.make_train_step(conf)
+    epoch = F.make_train_epoch(conf, n_steps=3, donate=False)
+
+    rng = np.random.RandomState(0)
+    xs = jnp.asarray(rng.rand(3, 10, 4).astype(np.float32))
+    ys = jnp.asarray(np.eye(3, dtype=np.float32)[rng.randint(0, 3, (3, 10))])
+    key = jax.random.PRNGKey(7)
+
+    p_seq, s_seq = params, states
+    seq_scores = []
+    for i in range(3):
+        sub = jax.random.fold_in(key, i)
+        p_seq, s_seq, sc = step(p_seq, s_seq, jnp.asarray(i), xs[i], ys[i], sub)
+        seq_scores.append(float(sc))
+
+    p_ep, s_ep, scores = epoch(params, states, jnp.asarray(0), xs, ys, key)
+    np.testing.assert_allclose(np.asarray(scores), seq_scores, rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(p_seq), jax.tree_util.tree_leaves(p_ep)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)

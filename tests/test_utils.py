@@ -203,3 +203,20 @@ class TestExtraIterators:
         # one label per window (9) is accepted
         it = MovingWindowDataSetIterator(4, data, np.ones((9, 1)), 2, 2)
         assert it.total_examples() == 9
+
+    def test_registry_list_ids_rejects_traversal(self, tmp_path):
+        from deeplearning4j_tpu.scaleout.registry import ConfigurationRegistry
+
+        reg = ConfigurationRegistry(str(tmp_path / "root"))
+        with pytest.raises(ValueError):
+            reg.list_ids("..")
+
+    def test_moving_window_per_window_scalar_labels(self):
+        import numpy as np
+        from deeplearning4j_tpu.datasets.iterator import MovingWindowDataSetIterator
+
+        data = np.arange(16).reshape(4, 4)
+        it = MovingWindowDataSetIterator(9, data, np.arange(9, dtype=float), 2, 2)
+        ds = it.next()
+        assert ds.labels.shape == (9, 1)
+        assert ds.labels[:, 0].tolist() == list(range(9))
