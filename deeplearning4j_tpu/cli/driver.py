@@ -57,9 +57,20 @@ def train(args) -> int:
     net = _load_model(args.conf, None)
     it = _make_iterator(args.input, args.batch, args.labels,
                         args.features, args.label_index)
-    for _ in range(args.epochs):
-        it.reset()
-        net.fit(it)
+    if args.runtime == "parallel":
+        # data-parallel over all visible devices (ref Train.execOnSpark
+        # dispatch → here the mesh trainer with in-graph averaging)
+        from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
+        from deeplearning4j_tpu.parallel.trainer import ParameterAveragingTrainer
+
+        trainer = ParameterAveragingTrainer(net, data_parallel_mesh())
+        for _ in range(args.epochs):
+            it.reset()
+            trainer.fit_data_set(it)
+    else:
+        for _ in range(args.epochs):
+            it.reset()
+            net.fit(it)
     _save_model(net, args.model)
     if args.verbose:
         print(f"saved params to {args.model}")
@@ -141,6 +152,10 @@ def build_parser() -> argparse.ArgumentParser:
     p_train = sub.add_parser("train", help="fit a model and save params")
     _add_common(p_train, needs_model_in=False)
     p_train.add_argument("--epochs", type=int, default=1)
+    p_train.add_argument("--runtime", choices=["local", "parallel"],
+                         default="local",
+                         help="'parallel' = data-parallel over all devices "
+                              "(ref -runtime Spark/Hadoop dispatch)")
     p_train.set_defaults(func=train)
 
     p_test = sub.add_parser("test", help="evaluate a saved model")

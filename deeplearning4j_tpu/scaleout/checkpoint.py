@@ -1,0 +1,111 @@
+"""Full-state training checkpoints.
+
+The reference checkpoints only conf JSON + flat params (ModelSaver /
+MultiLayerNetwork(String conf, INDArray params); SURVEY.md §5: "No
+optimizer-state or mid-epoch resume"). This build goes further: a checkpoint
+captures the complete training state — per-layer params, per-layer updater
+state (AdaGrad accumulators, momentum velocities), and the iteration counter
+— so training resumes bit-exactly where it stopped.
+
+Format: one .npz with flattened tree paths as keys plus the conf JSON;
+no framework-specific dependency (orbax would add async/multi-host machinery
+this single-controller runtime doesn't need yet).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+_CONF_KEY = "__conf_json__"
+_ITER_KEY = "__iteration__"
+_RNG_KEY = "__rng_key__"
+_TREEDEF_PREFIX = "tree::"
+
+
+def _flatten_with_paths(tree) -> Dict[str, np.ndarray]:
+    flat = {}
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+    for path, leaf in leaves_with_paths:
+        key = _TREEDEF_PREFIX + jax.tree_util.keystr(path)
+        flat[key] = np.asarray(leaf)
+    return flat
+
+
+def save_checkpoint(path: str, net, iteration: Optional[int] = None) -> str:
+    """Write params + updater state + iteration + conf. Returns the path."""
+    path = path if path.endswith(".npz") else path + ".npz"
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    payload: Dict[str, Any] = {}
+    for k, v in _flatten_with_paths({"params": net.params_tree}).items():
+        payload[k] = v
+    state = getattr(net, "_train_state", None)
+    if state is not None:
+        for k, v in _flatten_with_paths({"state": state}).items():
+            payload[k] = v
+    payload[_CONF_KEY] = np.frombuffer(
+        net.conf.to_json().encode(), dtype=np.uint8
+    )
+    it = iteration if iteration is not None else getattr(net, "_iteration", 0)
+    payload[_ITER_KEY] = np.asarray(it, np.int64)
+    keys = getattr(net, "_keys", None)
+    if keys is not None:
+        # persist the host RNG stream position so stochastic confs (dropout,
+        # drop-connect, AE corruption) also resume exactly
+        payload[_RNG_KEY] = np.asarray(
+            jax.random.key_data(keys._key)
+            if jax.dtypes.issubdtype(keys._key.dtype, jax.dtypes.prng_key)
+            else keys._key
+        )
+    tmp = path + ".tmp.npz"
+    np.savez(tmp.removesuffix(".npz"), **payload)
+    os.replace(tmp, path)
+    return path
+
+
+def load_checkpoint(path: str):
+    """Rebuild the network with params, updater state and iteration restored.
+
+    Returns (net, iteration).
+    """
+    from deeplearning4j_tpu.nn import functional as F
+    from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    if not path.endswith(".npz") and os.path.exists(path + ".npz"):
+        path = path + ".npz"
+    with np.load(path) as z:
+        conf = MultiLayerConfiguration.from_json(bytes(z[_CONF_KEY]).decode())
+        net = MultiLayerNetwork(conf).init()
+        iteration = int(z[_ITER_KEY])
+
+        # rebuild templates, then fill leaves by path key
+        params_template = net.params_tree
+        state_template = F.init_train_state(conf, params_template)
+
+        def fill(tree, label):
+            leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(
+                {label: tree}
+            )
+            new_leaves = []
+            for p, leaf in leaves_with_paths:
+                key = _TREEDEF_PREFIX + jax.tree_util.keystr(p)
+                if key not in z:
+                    raise KeyError(f"checkpoint missing leaf {key}")
+                new_leaves.append(np.asarray(z[key]).astype(leaf.dtype))
+            return jax.tree_util.tree_unflatten(treedef, new_leaves)[label]
+
+        net._params = tuple(fill(params_template, "params"))
+        has_state = any(k.startswith(_TREEDEF_PREFIX + "['state']")
+                        for k in z.files)
+        if has_state:
+            net._train_state = tuple(fill(state_template, "state"))
+        net._iteration = iteration
+        if _RNG_KEY in z.files:
+            net._keys._key = jax.numpy.asarray(z[_RNG_KEY],
+                                               dtype=jax.numpy.uint32)
+    return net, iteration

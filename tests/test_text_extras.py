@@ -1,0 +1,76 @@
+"""Inverted index + moving-window text tests (ref: LuceneInvertedIndex
+usage, text/movingwindow WindowsTest)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.text.inverted_index import InvertedIndex
+from deeplearning4j_tpu.text.movingwindow import (
+    PAD,
+    Window,
+    WindowConverter,
+    windows,
+)
+
+
+class TestInvertedIndex:
+    def _index(self):
+        idx = InvertedIndex()
+        idx.add_document("the cat sat".split())
+        idx.add_document("the dog ran".split())
+        idx.add_document("cat and dog".split())
+        return idx
+
+    def test_postings(self):
+        idx = self._index()
+        assert idx.documents("cat") == [0, 2]
+        assert idx.documents("the") == [0, 1]
+        assert idx.documents("zzz") == []
+        assert idx.doc_frequency("dog") == 2
+        assert idx.num_documents() == 3
+
+    def test_duplicate_tokens_counted_once(self):
+        idx = InvertedIndex()
+        idx.add_document(["a", "a", "b"])
+        assert idx.documents("a") == [0]
+
+    def test_batch_iter_covers_all(self):
+        idx = self._index()
+        docs = [d for batch in idx.batch_iter(2, seed=1) for d in batch]
+        assert len(docs) == 3
+        assert sorted(map(tuple, docs)) == sorted(
+            map(tuple, [idx.document(i) for i in range(3)])
+        )
+
+    def test_sample(self):
+        idx = self._index()
+        s = idx.sample(2, seed=0)
+        assert len(s) == 2
+
+
+class TestWindows:
+    def test_padding_and_focus(self):
+        ws = windows("a b c".split(), window_size=3)
+        assert len(ws) == 3
+        assert ws[0].tokens == [PAD, "a", "b"]
+        assert ws[0].focus_word == "a"
+        assert ws[2].tokens == ["b", "c", PAD]
+
+    def test_even_window_rejected(self):
+        with pytest.raises(ValueError):
+            windows(["a"], window_size=4)
+
+    def test_converter_concatenates_vectors(self):
+        class Lookup:
+            layer_size = 2
+
+            def vector(self, w):
+                return {"a": np.array([1.0, 2.0]),
+                        "b": np.array([3.0, 4.0])}.get(w)
+
+        ws = windows(["a", "b"], window_size=3)
+        conv = WindowConverter(Lookup())
+        m = conv.as_matrix(ws)
+        assert m.shape == (2, 6)
+        # first window: PAD a b -> zeros + [1,2] + [3,4]
+        np.testing.assert_array_equal(m[0], [0, 0, 1, 2, 3, 4])
