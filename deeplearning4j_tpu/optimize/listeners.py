@@ -8,6 +8,7 @@ side of the solver loop with the iteration index and current score.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Callable, Iterable, List
 
 log = logging.getLogger(__name__)
@@ -44,3 +45,32 @@ class CollectScoresListener:
 
     def __call__(self, model, iteration: int, score: float) -> None:
         self.scores.append((iteration, score))
+
+
+class TimingIterationListener:
+    """Wall-clock per-iteration timing (ref: the YARN worker's StopWatch
+    fields totalRunTimeWatch/batchWatch, impl/multilayer/WorkerNode.java).
+    The first callback only arms the clock (so compile/setup time before
+    iteration 0 is not counted); each later callback records the gap."""
+
+    def __init__(self, print_iterations: int = 50):
+        self._last: "float | None" = None
+        self.print_iterations = max(1, print_iterations)
+        self.timings_ms: List[float] = []
+
+    def __call__(self, model, iteration: int, score: float) -> None:
+        now = time.perf_counter()
+        if self._last is None:
+            self._last = now
+            return
+        ms = (now - self._last) * 1000.0
+        self._last = now
+        self.timings_ms.append(ms)
+        if iteration % self.print_iterations == 0:
+            log.info("Iteration %d took %.2f ms (score %s)", iteration, ms, score)
+
+    def total_ms(self) -> float:
+        return sum(self.timings_ms)
+
+    def mean_ms(self) -> float:
+        return self.total_ms() / max(len(self.timings_ms), 1)

@@ -21,6 +21,8 @@ multi-process setups.
 from __future__ import annotations
 
 import logging
+import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor, wait
 from typing import Optional
 
@@ -44,6 +46,7 @@ class LocalDistributedRunner:
         tracker: Optional[InMemoryStateTracker] = None,
         model_saver: Optional[ModelSaver] = None,
         max_rounds: int = 10_000,
+        fault_tolerant: bool = False,
     ):
         """performer_factory() -> WorkerPerformer (one per worker, mirroring
         WorkerPerformerFactory, ref: scaleout/perform/WorkerPerformerFactory)."""
@@ -57,6 +60,8 @@ class LocalDistributedRunner:
         self.job_iterator = job_iterator
         self.model_saver = model_saver
         self.max_rounds = max_rounds
+        self.fault_tolerant = fault_tolerant
+        self._requeued: deque = deque()  # jobs orphaned by failed workers
         for worker_id in self.performers:
             self.tracker.add_worker(worker_id)
 
@@ -70,10 +75,30 @@ class LocalDistributedRunner:
         job = self.tracker.job_for(worker_id)
         if job is None:
             return
+        t0 = time.perf_counter()
         performer.perform(job)
+        # per-job timing counter (ref: WorkerActor heartbeat ms logging,
+        # WorkerActor.java:198-202 / YARN WorkerNode StopWatch)
+        self.tracker.increment("job_ms_total",
+                               (time.perf_counter() - t0) * 1000.0)
         self.tracker.add_update(worker_id, job)
         self.tracker.clear_job(worker_id)
         self.tracker.increment("jobs_done")
+
+    def _handle_worker_failure(self, worker_id: str, exc: BaseException) -> None:
+        """Dead-worker recovery (ref: MasterActor stale-job GC + tracker
+        recentlyCleared re-route, MasterActor.java:115-142): the worker is
+        deregistered and its in-flight job requeued for a surviving worker."""
+        log.warning("worker %s failed: %s — rerouting its job", worker_id, exc)
+        job = self.tracker.job_for(worker_id)
+        self.tracker.clear_job(worker_id)
+        self.tracker.remove_worker(worker_id)
+        self.performers.pop(worker_id, None)
+        self.tracker.increment("worker_failures")
+        if job is not None:
+            # queue for reassignment: assigning directly could clobber a
+            # survivor's own in-flight job slot
+            self._requeued.append(job)
 
     def train(self):
         """Run rounds until the JobIterator is exhausted; returns the final
@@ -83,19 +108,39 @@ class LocalDistributedRunner:
             rounds = 0
             while rounds < self.max_rounds:
                 rounds += 1
-                # master: feed one job per worker
+                # master: feed one job per IDLE worker — orphaned jobs from
+                # failed workers first, then fresh ones from the iterator
                 fed = False
                 for worker_id in workers:
-                    if self.job_iterator.has_next():
+                    if self.tracker.job_for(worker_id) is not None:
+                        continue
+                    if self._requeued:
+                        job = self._requeued.popleft()
+                        job.worker_id = worker_id
+                        self.tracker.add_job(job)
+                        fed = True
+                    elif self.job_iterator.has_next():
                         self.tracker.add_job(self.job_iterator.next(worker_id))
                         fed = True
-                if not fed and not self.tracker.has_pending_jobs():
+                if (not fed and not self.tracker.has_pending_jobs()
+                        and not self._requeued):
                     break
                 # workers: one heartbeat each (parallel)
-                futures = [pool.submit(self._worker_round, w) for w in workers]
-                wait(futures)
-                for f in futures:
-                    f.result()  # surface worker exceptions
+                futures = {w: pool.submit(self._worker_round, w)
+                           for w in workers}
+                wait(futures.values())
+                for w, f in futures.items():
+                    exc = f.exception()
+                    if exc is None:
+                        continue
+                    if not self.fault_tolerant:
+                        raise exc
+                    self._handle_worker_failure(w, exc)
+                    workers = list(self.performers)
+                    if not workers:
+                        raise RuntimeError(
+                            "all workers failed"
+                        ) from exc
                 # master: aggregate when router policy allows
                 if self.router.send_work():
                     self.router.update()

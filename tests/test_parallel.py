@@ -102,3 +102,52 @@ def test_uneven_batch_padding():
                                         average_each_iteration=True)
     it = IrisDataSetIterator(150, 150)  # 150 % 8 != 0
     trainer.fit_data_set(it)  # must not raise
+
+
+class TestMultihost:
+    """Single-process behavior of the multi-host glue (a real multi-host run
+    needs multiple controllers; here we validate the single-controller path
+    and mesh construction over the 8 virtual devices)."""
+
+    def test_initialize_single_process_noop(self):
+        from deeplearning4j_tpu.parallel import multihost
+
+        multihost.initialize()  # no coordinator configured → no-op
+        idx, count = multihost.process_info()
+        assert idx == 0 and count == 1
+        assert multihost.is_coordinator()
+
+    def test_global_mesh_default(self):
+        import jax
+        from deeplearning4j_tpu.parallel import multihost
+
+        mesh = multihost.global_mesh(("data",))
+        assert mesh.shape["data"] == jax.device_count()
+
+    def test_global_mesh_multi_axis(self):
+        from deeplearning4j_tpu.parallel import multihost
+
+        mesh = multihost.global_mesh(("data", "model"), (4, 2))
+        assert dict(mesh.shape) == {"data": 4, "model": 2}
+
+    def test_global_mesh_validation(self):
+        import pytest as _pytest
+
+        from deeplearning4j_tpu.parallel import multihost
+
+        with _pytest.raises(ValueError):
+            multihost.global_mesh(("a", "b"))
+        with _pytest.raises(ValueError):
+            multihost.global_mesh(("a", "b"), (3, 2))
+
+    def test_explicit_coordinator_requires_rank(self):
+        import pytest as _pytest
+
+        from deeplearning4j_tpu.parallel import multihost
+
+        multihost._initialized = False
+        try:
+            with _pytest.raises(ValueError):
+                multihost.initialize(coordinator="h:1234")
+        finally:
+            multihost._initialized = True

@@ -239,3 +239,102 @@ class TestFullStateCheckpoint:
         net_b.fit(x, y)
         np.testing.assert_allclose(np.asarray(net_a.params()),
                                    np.asarray(net_b.params()), atol=1e-6)
+
+
+class TestFaultTolerance:
+    """Dead-worker recovery (ref: MasterActor stale-job GC + re-route,
+    §5 failure detection)."""
+
+    def _runner(self, fail_ids, fault_tolerant=True, num_workers=3):
+        from deeplearning4j_tpu.scaleout.job import CollectionJobIterator
+        from deeplearning4j_tpu.scaleout.perform import WorkerPerformer
+
+        class FlakyPerformer(WorkerPerformer):
+            def __init__(self, idx, fail_ids):
+                self.idx = idx
+                self.fail_ids = fail_ids
+
+            def perform(self, job):
+                if self.idx in self.fail_ids:
+                    raise RuntimeError(f"worker {self.idx} crashed")
+                job.result = np.asarray([float(job.work)])
+
+            def update(self, *args):
+                pass
+
+        counter = iter(range(100))
+        return LocalDistributedRunner(
+            performer_factory=lambda: FlakyPerformer(next(counter), fail_ids),
+            job_iterator=CollectionJobIterator(list(range(6))),
+            num_workers=num_workers,
+            fault_tolerant=fault_tolerant,
+        )
+
+    def test_failed_worker_job_rerouted(self):
+        runner = self._runner(fail_ids={1})
+        runner.train()
+        # all 6 jobs completed despite worker 1 dying
+        assert runner.tracker.count("jobs_done") == 6
+        assert runner.tracker.count("worker_failures") == 1
+        assert len(runner.tracker.workers()) == 2
+
+    def test_not_fault_tolerant_raises(self):
+        runner = self._runner(fail_ids={1}, fault_tolerant=False)
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError, match="crashed"):
+            runner.train()
+
+    def test_all_workers_failed_raises(self):
+        runner = self._runner(fail_ids={0, 1}, num_workers=2)
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError, match="all workers failed"):
+            runner.train()
+
+    def test_job_timing_counter(self):
+        runner = self._runner(fail_ids=set())
+        runner.train()
+        assert runner.tracker.count("job_ms_total") > 0
+
+
+def test_timing_iteration_listener():
+    from deeplearning4j_tpu.optimize.listeners import TimingIterationListener
+
+    listener = TimingIterationListener(print_iterations=100)
+    for i in range(5):
+        listener(None, i, 1.0)
+    # first callback only arms the clock (compile/setup excluded)
+    assert len(listener.timings_ms) == 4
+    assert listener.total_ms() >= 0
+    assert listener.mean_ms() >= 0
+
+
+def test_two_workers_fail_same_round_no_job_lost():
+    """Regression: two reroutes in one round must not clobber each other or
+    a survivor's in-flight job."""
+    from deeplearning4j_tpu.scaleout.job import CollectionJobIterator
+    from deeplearning4j_tpu.scaleout.perform import WorkerPerformer
+
+    class Flaky(WorkerPerformer):
+        def __init__(self, idx):
+            self.idx = idx
+
+        def perform(self, job):
+            if self.idx in (0, 1):
+                raise RuntimeError(f"worker {self.idx} crashed")
+            job.result = np.asarray([float(job.work)])
+
+        def update(self, *args):
+            pass
+
+    counter = iter(range(100))
+    runner = LocalDistributedRunner(
+        performer_factory=lambda: Flaky(next(counter)),
+        job_iterator=CollectionJobIterator(list(range(6))),
+        num_workers=3,
+        fault_tolerant=True,
+    )
+    runner.train()
+    assert runner.tracker.count("jobs_done") == 6
+    assert runner.tracker.count("worker_failures") == 2
