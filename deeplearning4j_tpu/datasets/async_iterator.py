@@ -34,6 +34,7 @@ class AsyncDataSetIterator(DataSetIterator):
         self._queue: Optional[queue.Queue] = None
         self._thread: Optional[threading.Thread] = None
         self._next_item = None
+        self._producer_error: Optional[BaseException] = None
         self._start()
 
     def _start(self) -> None:
@@ -45,9 +46,12 @@ class AsyncDataSetIterator(DataSetIterator):
             try:
                 while self.backing.has_next():
                     q.put(self.backing.next())
+            except BaseException as exc:  # surfaced from has_next()/next()
+                self._producer_error = exc
             finally:
                 q.put(_SENTINEL)
 
+        self._producer_error = None
         self._thread = threading.Thread(target=produce, daemon=True)
         self._thread.start()
         self._next_item = None
@@ -63,6 +67,9 @@ class AsyncDataSetIterator(DataSetIterator):
     def has_next(self) -> bool:
         if self._next_item is None:
             self._next_item = self._queue.get()
+        if self._next_item is _SENTINEL and self._producer_error is not None:
+            exc, self._producer_error = self._producer_error, None
+            raise exc
         return self._next_item is not _SENTINEL
 
     def next(self, num=None) -> DataSet:

@@ -225,8 +225,13 @@ class NativeCSVLoader:
                     buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
                     buf.size,
                 )
-                if n <= 0:
+                if n == 0:  # clean end-of-epoch
                     return
+                if n < 0:  # error (e.g. out_capacity too small) — never EOF
+                    raise RuntimeError(
+                        f"native loader error for {self.path!r}: "
+                        f"{self._lib.dl4j_last_error().decode()}"
+                    )
                 yield buf[: n * self.cols].reshape(n, self.cols).copy()
         else:
             data = self._fallback

@@ -102,6 +102,10 @@ class NeuralNetConfiguration:
         object.__setattr__(self, "momentum_after", _freeze_schedule(self.momentum_after))
         for f in ("filter_size", "stride", "feature_map_size"):
             object.__setattr__(self, f, tuple(int(x) for x in getattr(self, f)))
+        # fail at conf time, not first trace: a typo'd activation should raise
+        # here with the list of known names
+        from deeplearning4j_tpu.ops.activations import activation as _act
+        _act(self.activation_function)
         if self.dist is not None:
             k, a, b = self.dist
             object.__setattr__(self, "dist", (str(k), float(a), float(b)))

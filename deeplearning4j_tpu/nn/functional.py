@@ -88,6 +88,29 @@ def network_loss(
     key: Optional[Array] = None,
 ) -> Array:
     """Loss through the whole stack; the head uses the fused-logits path."""
+    from deeplearning4j_tpu.ops.losses import finalize_loss
+
+    per = network_per_example_loss(conf, params, x, labels, train=train, key=key)
+    head = conf.conf(conf.n_layers - 1)
+    return finalize_loss(head.loss_function, jnp.mean(per))
+
+
+def network_per_example_loss(
+    conf: MultiLayerConfiguration,
+    params: NetParams,
+    x: Array,
+    labels: Array,
+    *,
+    train: bool = False,
+    key: Optional[Array] = None,
+) -> Array:
+    """Per-example pre-reduction losses, shape (batch,).
+
+    The scalar ``network_loss`` equals
+    ``ops.losses.finalize_loss(head.loss_function, mean(per_example))``;
+    data-parallel callers weight rows (padding masks) and normalize the mean
+    across shards with a psum so uneven batches stay unbiased.
+    """
     n = conf.n_layers
     keys = jax.random.split(key, n) if key is not None else [None] * n
     for i in range(n - 1):
@@ -97,9 +120,10 @@ def network_loss(
     x = _maybe_preprocess(conf, n - 1, x)
     head = conf.conf(n - 1)
     if head.layer_type != LayerType.OUTPUT:
-        raise ValueError("network_loss requires an OUTPUT head layer")
-    return output_layer.output_loss(head, params[n - 1], x, labels, train=train,
-                                    key=keys[n - 1], drop_connect=conf.use_drop_connect)
+        raise ValueError("network_per_example_loss requires an OUTPUT head layer")
+    return output_layer.output_per_example_loss(
+        head, params[n - 1], x, labels, train=train,
+        key=keys[n - 1], drop_connect=conf.use_drop_connect)
 
 
 def make_train_step(conf: MultiLayerConfiguration, donate: bool = False,

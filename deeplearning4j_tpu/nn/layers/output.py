@@ -16,7 +16,12 @@ import jax.numpy as jnp
 from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.layers.dense import apply_dropout, pre_output
 from deeplearning4j_tpu.ops.activations import activation
-from deeplearning4j_tpu.ops.losses import FUSABLE, loss, loss_from_logits
+from deeplearning4j_tpu.ops.losses import (
+    FUSABLE,
+    finalize_loss,
+    per_example_loss,
+    per_example_loss_from_logits,
+)
 
 
 def forward(
@@ -47,15 +52,35 @@ def output_loss(
     drop_connect: bool = False,
 ) -> jax.Array:
     """Scalar training loss for the head (ref: OutputLayer.score())."""
+    per = output_per_example_loss(conf, params, x, labels, train=train,
+                                  key=key, drop_connect=drop_connect)
+    return finalize_loss(conf.loss_function, jnp.mean(per))
+
+
+def output_per_example_loss(
+    conf: NeuralNetConfiguration,
+    params: Dict[str, jax.Array],
+    x: jax.Array,
+    labels: jax.Array,
+    *,
+    train: bool = False,
+    key: Optional[jax.Array] = None,
+    drop_connect: bool = False,
+) -> jax.Array:
+    """Per-example pre-reduction losses, shape (batch,).
+
+    Scalar loss = ops.losses.finalize_loss(conf.loss_function, weighted mean);
+    keeping rows separate lets data-parallel callers mask padded rows and
+    normalize across shards exactly.
+    """
     kdrop = kdc = None
     if key is not None:
         kdrop, kdc = jax.random.split(key)
     x = apply_dropout(x, conf.dropout, train, kdrop)
     logits = pre_output(conf, params, x, train=train, key=kdc, drop_connect=drop_connect)
-    # losses always accumulate in float32 even under a bf16 compute policy
     logits = logits.astype(jnp.float32)
     labels = labels.astype(jnp.float32)
     if (conf.activation_function, conf.loss_function) in FUSABLE:
-        return loss_from_logits(conf.loss_function, labels, logits)
+        return per_example_loss_from_logits(conf.loss_function, labels, logits)
     out = activation(conf.activation_function)(logits)
-    return loss(conf.loss_function, labels, out)
+    return per_example_loss(conf.loss_function, labels, out)

@@ -42,27 +42,57 @@ def _clip(p: Array) -> Array:
     return jnp.clip(p, _EPS, 1.0 - _EPS)
 
 
-def loss(kind: "LossFunction | str", labels: Array, output: Array) -> Array:
-    """Scalar loss. `output` is the network's activated output."""
+def per_example_loss(kind: "LossFunction | str", labels: Array, output: Array) -> Array:
+    """Per-example pre-reduction loss values, shape ``labels.shape[:-1]``.
+
+    The scalar loss is ``finalize_loss(kind, mean(per_example))``; keeping the
+    per-example values exposed lets callers weight rows (padding masks,
+    importance weights) and normalize across device shards exactly.
+    """
     kind = LossFunction.coerce(kind)
-    n = labels.shape[0]
     if kind == LossFunction.MSE:
-        return jnp.mean(jnp.sum((labels - output) ** 2, axis=-1) / 2.0)
+        return jnp.sum((labels - output) ** 2, axis=-1) / 2.0
     if kind == LossFunction.SQUARED_LOSS:
-        return jnp.sum((labels - output) ** 2) / n
+        return jnp.sum((labels - output) ** 2, axis=-1)
     if kind == LossFunction.RMSE_XENT:
-        xent = -(labels * jnp.log(_clip(output)))
-        return jnp.sqrt(jnp.mean(jnp.sum(xent, axis=-1)) + _EPS)
+        return jnp.sum(-(labels * jnp.log(_clip(output))), axis=-1)
     if kind in (LossFunction.XENT, LossFunction.RECONSTRUCTION_CROSSENTROPY):
         p = _clip(output)
-        return -jnp.mean(
-            jnp.sum(labels * jnp.log(p) + (1.0 - labels) * jnp.log(1.0 - p), axis=-1)
+        return -jnp.sum(
+            labels * jnp.log(p) + (1.0 - labels) * jnp.log(1.0 - p), axis=-1
         )
     if kind in (LossFunction.MCXENT, LossFunction.NEGATIVELOGLIKELIHOOD):
-        return -jnp.mean(jnp.sum(labels * jnp.log(_clip(output)), axis=-1))
+        return -jnp.sum(labels * jnp.log(_clip(output)), axis=-1)
     if kind == LossFunction.EXPLL:
-        return jnp.mean(jnp.sum(output - labels * jnp.log(_clip(output)), axis=-1))
+        return jnp.sum(output - labels * jnp.log(_clip(output)), axis=-1)
     raise ValueError(f"Unhandled loss function {kind}")
+
+
+def per_example_loss_from_logits(
+    kind: "LossFunction | str", labels: Array, logits: Array
+) -> Array:
+    """Per-example values for the fused softmax/sigmoid + cross-entropy path."""
+    kind = LossFunction.coerce(kind)
+    if kind in (LossFunction.MCXENT, LossFunction.NEGATIVELOGLIKELIHOOD):
+        return -jnp.sum(labels * jax.nn.log_softmax(logits, axis=-1), axis=-1)
+    if kind in (LossFunction.XENT, LossFunction.RECONSTRUCTION_CROSSENTROPY):
+        # sigmoid cross entropy on logits: max(x,0) - x*z + log(1+exp(-|x|))
+        x, z = logits, labels
+        per = jnp.maximum(x, 0) - x * z + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        return jnp.sum(per, axis=-1)
+    raise ValueError(f"No fused-logits path for {kind}")
+
+
+def finalize_loss(kind: "LossFunction | str", mean_value: Array) -> Array:
+    """Post-reduction transform: identity except RMSE_XENT's sqrt."""
+    if LossFunction.coerce(kind) == LossFunction.RMSE_XENT:
+        return jnp.sqrt(mean_value + _EPS)
+    return mean_value
+
+
+def loss(kind: "LossFunction | str", labels: Array, output: Array) -> Array:
+    """Scalar loss. `output` is the network's activated output."""
+    return finalize_loss(kind, jnp.mean(per_example_loss(kind, labels, output)))
 
 
 def loss_from_logits(kind: "LossFunction | str", labels: Array, logits: Array) -> Array:
@@ -71,15 +101,9 @@ def loss_from_logits(kind: "LossFunction | str", labels: Array, logits: Array) -
     XLA fuses log_softmax into the preceding matmul; used by OutputLayer when
     the activation/loss pair allows it (softmax+MCXENT, sigmoid+XENT).
     """
-    kind = LossFunction.coerce(kind)
-    if kind in (LossFunction.MCXENT, LossFunction.NEGATIVELOGLIKELIHOOD):
-        return -jnp.mean(jnp.sum(labels * jax.nn.log_softmax(logits, axis=-1), axis=-1))
-    if kind in (LossFunction.XENT, LossFunction.RECONSTRUCTION_CROSSENTROPY):
-        # sigmoid cross entropy on logits: max(x,0) - x*z + log(1+exp(-|x|))
-        x, z = logits, labels
-        per = jnp.maximum(x, 0) - x * z + jnp.log1p(jnp.exp(-jnp.abs(x)))
-        return jnp.mean(jnp.sum(per, axis=-1))
-    raise ValueError(f"No fused-logits path for {kind}")
+    return finalize_loss(
+        kind, jnp.mean(per_example_loss_from_logits(kind, labels, logits))
+    )
 
 
 FUSABLE = {

@@ -43,36 +43,69 @@ from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
 Array = jax.Array
 
 
-def _local_grad_step(conf, params, states, iteration, x, y, key, pmean_grads: bool):
-    """One update step; optionally pmean the grads across the data axis."""
+def _local_grad_step(conf, params, states, iteration, x, y, w, key,
+                     sync_grads: bool):
+    """One update step over a weighted batch shard.
+
+    ``w`` is a per-row weight (0 for padded rows). The loss is the weighted
+    mean of per-example losses; with ``sync_grads`` the normalizer is psum'd
+    across the data axis first, so the gradient on an uneven (padded) global
+    batch is EXACTLY the gradient of the unpadded batch — no duplicate-row
+    bias (the reference sidesteps this by repartitioning the RDD,
+    ref: SparkDl4jMultiLayer.java:164).
+    """
+    from deeplearning4j_tpu.ops.losses import finalize_loss
+
     kdrop, _ = jax.random.split(key)
+    head = conf.conf(conf.n_layers - 1)
 
     def loss_fn(ps):
-        return F.network_loss(conf, ps, x, y, train=True, key=kdrop)
+        per = F.network_per_example_loss(conf, ps, x, y, train=True, key=kdrop)
+        lsum = jnp.sum(per * w)
+        wsum = jnp.sum(w)
+        if sync_grads:
+            # global weighted mean: psum the numerator and denominator so each
+            # shard differentiates the SAME scalar (psum transposes to
+            # identity, so per-shard grads are partial grads of the global
+            # loss; summing them below completes the chain rule)
+            lsum = jax.lax.psum(lsum, DATA_AXIS)
+            wsum = jax.lax.psum(wsum, DATA_AXIS)
+        wsum = jnp.maximum(wsum, 1e-8)  # all-padded shard in local mode
+        return finalize_loss(head.loss_function, lsum / wsum)
 
     score, grads = jax.value_and_grad(loss_fn)(params)
-    if pmean_grads:
-        grads = jax.lax.pmean(grads, DATA_AXIS)
-        score = jax.lax.pmean(score, DATA_AXIS)
+    if sync_grads:
+        grads = jax.lax.psum(grads, DATA_AXIS)
+        upd_scale = jnp.float32(1.0)
+    else:
+        # all-padded shard in local mode: freeze params entirely — otherwise
+        # apply_updater's L1/L2 decay would still drift them on zero grads
+        upd_scale = jnp.where(jnp.sum(w) > 0, 1.0, 0.0).astype(jnp.float32)
     new_params = []
     new_states = []
     for i in range(conf.n_layers):
         upd, st = apply_updater(conf.conf(i), iteration, grads[i], params[i], states[i])
-        new_params.append(jax.tree_util.tree_map(lambda p, u: p - u, params[i], upd))
+        new_params.append(jax.tree_util.tree_map(
+            lambda p, u: p - upd_scale * u, params[i], upd))
         new_states.append(st)
     return tuple(new_params), tuple(new_states), score
 
 
 def make_sync_train_step(conf: MultiLayerConfiguration, mesh: Mesh):
-    """Per-step averaging: grads AllReduced every iteration."""
+    """Per-step averaging: grads AllReduced every iteration.
 
-    def step(params, states, iteration, x, y, key):
-        return _local_grad_step(conf, params, states, iteration, x, y, key, True)
+    step(params, states, iteration, x, y, w, key) — ``w`` is the per-row
+    weight vector (0 = padded row), see _local_grad_step.
+    """
+
+    def step(params, states, iteration, x, y, w, key):
+        return _local_grad_step(conf, params, states, iteration, x, y, w, key,
+                                True)
 
     sharded = jax.shard_map(
         step,
         mesh=mesh,
-        in_specs=(P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS), P()),
+        in_specs=(P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
         out_specs=(P(), P(), P()),
         check_vma=False,
     )
@@ -84,12 +117,12 @@ def make_local_fit_step(conf: MultiLayerConfiguration, mesh: Mesh,
     """Per-fit averaging: each device runs `local_iterations` steps on its own
     shard with zero cross-device traffic, then params/states are pmean'd once."""
 
-    def local_fit(params, states, iteration0, x, y, key):
+    def local_fit(params, states, iteration0, x, y, w, key):
         def body(carry, i):
             params, states = carry
             step_key = jax.random.fold_in(key, i)
             params, states, score = _local_grad_step(
-                conf, params, states, iteration0 + i, x, y, step_key, False
+                conf, params, states, iteration0 + i, x, y, w, step_key, False
             )
             return (params, states), score
 
@@ -97,15 +130,23 @@ def make_local_fit_step(conf: MultiLayerConfiguration, mesh: Mesh,
             body, (params, states), jnp.arange(local_iterations)
         )
         # the single aggregation round: in-graph AllReduce replaces the
-        # reference's results.fold(zeros, Add) ÷ numPartitions on the driver
-        params = jax.lax.pmean(params, DATA_AXIS)
-        states = jax.lax.pmean(states, DATA_AXIS)
-        return params, states, jax.lax.pmean(scores[-1], DATA_AXIS)
+        # reference's results.fold(zeros, Add) ÷ numPartitions on the driver.
+        # Weighted by each shard's sample count so all-padded shards (batch
+        # smaller than the mesh) contribute nothing; equal-weight pmean when
+        # shards are balanced, matching the reference's repartitioned RDDs.
+        wsum = jnp.sum(w)
+        wtot = jnp.maximum(jax.lax.psum(wsum, DATA_AXIS), 1e-8)
+        frac = wsum / wtot
+        params = jax.lax.psum(
+            jax.tree_util.tree_map(lambda p: p * frac, params), DATA_AXIS)
+        states = jax.lax.psum(
+            jax.tree_util.tree_map(lambda s: s * frac, states), DATA_AXIS)
+        return params, states, jax.lax.psum(scores[-1] * frac, DATA_AXIS)
 
     sharded = jax.shard_map(
         local_fit,
         mesh=mesh,
-        in_specs=(P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS), P()),
+        in_specs=(P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
         out_specs=(P(), P(), P()),
         check_vma=False,
     )
@@ -147,15 +188,29 @@ class ParameterAveragingTrainer:
 
     def _pad_to_devices(self, x):
         """Pad the batch so it divides the data-axis size (the reference
-        repartitions the RDD to the worker count, :164)."""
+        repartitions the RDD to the worker count, :164). Padded rows repeat
+        the last sample but carry 0 weight in the returned mask, so they
+        never enter the loss or gradient."""
         n = x.shape[0]
         d = self.mesh.shape[DATA_AXIS]
         rem = n % d
         if rem == 0:
-            return x, n
+            return x, jnp.ones((n,), jnp.float32)
         pad = d - rem
         reps = jnp.concatenate([x, jnp.repeat(x[-1:], pad, axis=0)], axis=0)
-        return reps, n
+        w = jnp.concatenate([jnp.ones((n,), jnp.float32),
+                             jnp.zeros((pad,), jnp.float32)])
+        return reps, w
+
+    def _pad_batch(self, batch):
+        """(features, labels, weight-mask), all padded to the data-axis size."""
+        x, w = self._pad_to_devices(jnp.asarray(batch.features))
+        n = batch.labels.shape[0]
+        d = self.mesh.shape[DATA_AXIS]
+        y = jnp.asarray(batch.labels)
+        if n % d:
+            y = jnp.concatenate([y, jnp.repeat(y[-1:], d - n % d, axis=0)], axis=0)
+        return x, y, w
 
     def fit_data_set(self, data: DataSetIterator) -> None:
         """ref: SparkDl4jMultiLayer.fitDataSet(JavaRDD<DataSet>)."""
@@ -176,10 +231,9 @@ class ParameterAveragingTrainer:
                 self._sync_step = make_sync_train_step(net.conf, self.mesh)
             step = self._sync_step
             for batch in data:
-                x, _ = self._pad_to_devices(jnp.asarray(batch.features))
-                y, _ = self._pad_to_devices(jnp.asarray(batch.labels))
+                x, y, w = self._pad_batch(batch)
                 params, states, score = step(
-                    params, states, jnp.asarray(self._iteration), x, y,
+                    params, states, jnp.asarray(self._iteration), x, y, w,
                     net._keys.next(),
                 )
                 self._iteration += 1
@@ -192,10 +246,9 @@ class ParameterAveragingTrainer:
                 )
             step = self._fit_step
             for batch in data:
-                x, _ = self._pad_to_devices(jnp.asarray(batch.features))
-                y, _ = self._pad_to_devices(jnp.asarray(batch.labels))
+                x, y, w = self._pad_batch(batch)
                 params, states, score = step(
-                    params, states, jnp.asarray(self._iteration), x, y,
+                    params, states, jnp.asarray(self._iteration), x, y, w,
                     net._keys.next(),
                 )
                 self._iteration += self.local_iterations

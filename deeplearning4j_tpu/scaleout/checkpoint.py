@@ -23,6 +23,7 @@ import numpy as np
 _CONF_KEY = "__conf_json__"
 _ITER_KEY = "__iteration__"
 _RNG_KEY = "__rng_key__"
+_RNG_IMPL_KEY = "__rng_impl__"
 _TREEDEF_PREFIX = "tree::"
 
 
@@ -56,11 +57,13 @@ def save_checkpoint(path: str, net, iteration: Optional[int] = None) -> str:
     if keys is not None:
         # persist the host RNG stream position so stochastic confs (dropout,
         # drop-connect, AE corruption) also resume exactly
-        payload[_RNG_KEY] = np.asarray(
-            jax.random.key_data(keys._key)
-            if jax.dtypes.issubdtype(keys._key.dtype, jax.dtypes.prng_key)
-            else keys._key
-        )
+        if jax.dtypes.issubdtype(keys._key.dtype, jax.dtypes.prng_key):
+            payload[_RNG_KEY] = np.asarray(jax.random.key_data(keys._key))
+            payload[_RNG_IMPL_KEY] = np.frombuffer(
+                str(jax.random.key_impl(keys._key)).encode(), dtype=np.uint8
+            )
+        else:
+            payload[_RNG_KEY] = np.asarray(keys._key)
     tmp = path + ".tmp.npz"
     np.savez(tmp.removesuffix(".npz"), **payload)
     os.replace(tmp, path)
@@ -106,6 +109,11 @@ def load_checkpoint(path: str):
             net._train_state = tuple(fill(state_template, "state"))
         net._iteration = iteration
         if _RNG_KEY in z.files:
-            net._keys._key = jax.numpy.asarray(z[_RNG_KEY],
-                                               dtype=jax.numpy.uint32)
+            raw = jax.numpy.asarray(z[_RNG_KEY], dtype=jax.numpy.uint32)
+            if _RNG_IMPL_KEY in z.files:
+                # key was typed at save time: restore the same key flavor
+                impl = bytes(z[_RNG_IMPL_KEY]).decode()
+                net._keys._key = jax.random.wrap_key_data(raw, impl=impl)
+            else:
+                net._keys._key = raw
     return net, iteration

@@ -68,9 +68,17 @@ static inline float parse_float(const char* p, const char* end,
     }
   }
   if (digits == 0) {  // not a plain number (inf/nan/hex/garbage)
+    // strtof needs NUL termination the mmap'd buffer doesn't guarantee, and
+    // would happily scan past `end`; copy the token into a bounded stack
+    // buffer first.
+    char tok[64];
+    size_t len = static_cast<size_t>(end - p);
+    if (len > sizeof(tok) - 1) len = sizeof(tok) - 1;
+    std::memcpy(tok, p, len);
+    tok[len] = '\0';
     char* next = nullptr;
-    float v = strtof(p, &next);
-    *out = next;
+    float v = strtof(tok, &next);
+    *out = p + (next - tok);
     return v;
   }
   int exponent = -frac_digits;
@@ -334,7 +342,11 @@ int64_t dl4j_loader_next(void* handle, float* out, int64_t out_capacity) {
   if (ld->queue.empty()) return 0;
   std::vector<float>& front = ld->queue.front();
   int64_t n = static_cast<int64_t>(front.size());
-  if (n > out_capacity) return -1;
+  if (n > out_capacity) {
+    g_last_error = "out_capacity " + std::to_string(out_capacity) +
+                   " too small for batch of " + std::to_string(n) + " floats";
+    return -1;
+  }
   std::memcpy(out, front.data(), n * sizeof(float));
   ld->queue.pop_front();
   ld->not_full.notify_one();

@@ -143,6 +143,21 @@ class TestAsyncIterator:
             count = sum(b.num_examples() for b in iter(it))
             assert count == 30
 
+    def test_producer_error_propagates(self):
+        class Exploding(ListDataSetIterator):
+            def next(self, num=None):
+                if self._cursor >= 14:
+                    raise RuntimeError("backing iterator died")
+                return super().next(num)
+
+        rng = np.random.RandomState(0)
+        ds = DataSet(rng.rand(30, 4).astype(np.float32),
+                     np.eye(2, dtype=np.float32)[rng.randint(0, 2, 30)])
+        it = AsyncDataSetIterator(Exploding(ds, 7), capacity=2)
+        with pytest.raises(RuntimeError, match="backing iterator died"):
+            while it.has_next():
+                it.next()
+
 
 class TestNativeCSVDataSetIterator:
     def test_one_hot_and_epoch(self, csv_path):

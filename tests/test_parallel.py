@@ -104,6 +104,29 @@ def test_uneven_batch_padding():
     trainer.fit_data_set(it)  # must not raise
 
 
+def test_uneven_batch_gradient_unbiased():
+    """Padded rows are 0-weighted: one sync-DP step on an uneven batch must
+    land on the SAME params as the single-device step on the unpadded batch
+    (padding duplicates previously entered the loss at full weight)."""
+    net_par = MultiLayerNetwork(iris_conf(num_iterations=1)).init()
+    net_seq = MultiLayerNetwork(iris_conf(num_iterations=1)).init()
+    net_seq.set_params(net_par.params())
+
+    it = IrisDataSetIterator(150, 150)  # 150 % 8 = 6 → 2 padded rows
+    trainer = ParameterAveragingTrainer(net_par, data_parallel_mesh(8),
+                                        average_each_iteration=True)
+    trainer.fit_data_set(it)
+
+    it.reset()
+    batch = it.next()
+    assert batch.features.shape[0] == 150
+    net_seq._do_backward(batch.features, batch.labels)
+    np.testing.assert_allclose(
+        np.asarray(net_par.params()), np.asarray(net_seq.params()),
+        rtol=2e-3, atol=2e-4,
+    )
+
+
 class TestMultihost:
     """Single-process behavior of the multi-host glue (a real multi-host run
     needs multiple controllers; here we validate the single-controller path
