@@ -1,13 +1,18 @@
-"""Benchmark: MNIST MLP training throughput (BASELINE config #1).
+"""Benchmarks: MNIST MLP + LeNet training throughput (BASELINE configs #1, #2).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
 
-- value: steady-state training samples/sec/chip on the default platform
-  (the real TPU chip under the driver).
-- vs_baseline: ratio vs the same training step measured in a CPU subprocess —
-  the stand-in for the reference's nd4j-native CPU backend (the reference
-  publishes no numbers, BASELINE.md; its jblas CPU path is the comparison
-  point named in BASELINE.json's north star, target ≥5×).
+- value: steady-state bf16 training samples/sec/chip for the MLP on the
+  default platform (the real TPU chip under the driver). Mixed precision =
+  bf16 compute on the MXU with fp32 master params (ops/dtypes.py Policy);
+  a loss-parity test (tests/test_mixed_precision.py) gates bf16 vs fp32
+  accuracy.
+- vs_baseline: ratio vs the same fp32 training step measured in a CPU
+  subprocess — the stand-in for the reference's nd4j-native CPU backend
+  (the reference publishes no numbers, BASELINE.md; its jblas CPU path is
+  the comparison point named in BASELINE.json's north star, target ≥5×).
+- detail: fp32/bf16 throughput for both models plus model FLOP utilization
+  (MFU) against the chip's bf16 peak.
 """
 
 from __future__ import annotations
@@ -23,8 +28,28 @@ WARMUP = 5
 MEASURE = 30
 HID1, HID2 = 500, 300
 
+# TPU v5e (v5 lite) peak bf16 matmul throughput per chip.
+PEAK_BF16_FLOPS = 197e12
 
-def measure(steps: int = MEASURE, batch: int = BATCH,
+# Analytic model FLOPs per training sample (fwd matmul/conv FLOPs ×3 for
+# fwd + both backward matmuls; elementwise ops are bandwidth, not FLOP,
+# bound and excluded — standard MFU accounting).
+MLP_FWD_FLOPS = 2 * (784 * HID1 + HID1 * HID2 + HID2 * 10)
+# LeNet: conv1 24²×6×(5²×1), conv2 8²×16×(5²×6), dense 256×120, 120×84, 84×10
+LENET_FWD_FLOPS = 2 * (
+    24 * 24 * 6 * 25 + 8 * 8 * 16 * 150 + 256 * 120 + 120 * 84 + 84 * 10
+)
+TRAIN_FLOPS = {"mlp": 3 * MLP_FWD_FLOPS, "lenet": 3 * LENET_FWD_FLOPS}
+
+
+def _conf(model: str):
+    from deeplearning4j_tpu.models.zoo import lenet, mnist_mlp
+
+    return mnist_mlp(HID1, HID2) if model == "mlp" else lenet()
+
+
+def measure(model: str = "mlp", precision: str = "fp32",
+            steps: int = MEASURE, batch: int = BATCH,
             chunk: int = 10) -> float:
     """Steady-state training samples/sec with the step loop kept ON DEVICE:
     `chunk` steps run as one lax.scan program per dispatch, so the metric
@@ -34,13 +59,14 @@ def measure(steps: int = MEASURE, batch: int = BATCH,
     import jax.numpy as jnp
 
     from deeplearning4j_tpu.datasets.fetchers import synthetic_mnist
-    from deeplearning4j_tpu.models.zoo import mnist_mlp
     from deeplearning4j_tpu.nn import functional as F
+    from deeplearning4j_tpu.ops.dtypes import BF16_COMPUTE
 
-    conf = mnist_mlp(HID1, HID2)
+    conf = _conf(model)
+    policy = BF16_COMPUTE if precision == "bf16" else None
     params = F.init_params(conf, jax.random.PRNGKey(0))
     states = F.init_train_state(conf, params)
-    epoch = F.make_train_epoch(conf, chunk, donate=True)
+    epoch = F.make_train_epoch(conf, chunk, donate=True, policy=policy)
 
     xs, ys = synthetic_mnist(batch * chunk)
     x = jnp.asarray(xs).reshape(chunk, batch, -1)
@@ -66,8 +92,8 @@ def measure(steps: int = MEASURE, batch: int = BATCH,
 
 
 def _cpu_baseline() -> float:
-    """Run the same measurement on CPU in a subprocess (jax config must be
-    flipped before backend init; the ambient sitecustomize pins the TPU)."""
+    """Run the fp32 MLP measurement on CPU in a subprocess (jax config must
+    be flipped before backend init; the ambient sitecustomize pins the TPU)."""
     code = (
         "import jax\n"
         "jax.config.update('jax_platforms','cpu')\n"
@@ -89,15 +115,63 @@ def _cpu_baseline() -> float:
     return 0.0
 
 
+def mfu(model: str, samples_per_sec: float) -> float:
+    return samples_per_sec * TRAIN_FLOPS[model] / PEAK_BF16_FLOPS
+
+
+def measure_word2vec(n_sentences: int = 2000, sent_len: int = 100,
+                     vocab: int = 5000) -> float:
+    """End-to-end Word2Vec skip-gram words/sec (BASELINE config #4): host
+    tokenization + vectorized pair generation + device SGNS steps. Counted in
+    corpus words per second, the reference's unit (Word2Vec.java:303-342)."""
+    import time as _time
+
+    import numpy as np
+
+    from deeplearning4j_tpu.models.word2vec import Word2Vec
+    from deeplearning4j_tpu.text.sentence_iterator import (
+        CollectionSentenceIterator,
+    )
+
+    rng = np.random.default_rng(0)
+    # zipf-ish corpus so the unigram table and subsampling do real work
+    words = [f"w{i}" for i in range(vocab)]
+    probs = 1.0 / np.arange(1, vocab + 1)
+    probs /= probs.sum()
+    sents = [
+        " ".join(np.array(words)[rng.choice(vocab, sent_len, p=probs)])
+        for _ in range(n_sentences)
+    ]
+    vec = Word2Vec(
+        sentence_iterator=CollectionSentenceIterator(sents),
+        layer_size=100, window=5, negative=5, iterations=1,
+        sample=1e-3, batch_size=8192, seed=1,
+    )
+    vec.build_vocab()
+    t0 = _time.perf_counter()
+    vec.fit()
+    dt = _time.perf_counter() - t0
+    return n_sentences * sent_len / dt
+
+
 def main() -> None:
-    value = measure()
+    detail = {}
+    for model in ("mlp", "lenet"):
+        for precision in ("fp32", "bf16"):
+            sps = measure(model, precision)
+            detail[f"{model}_{precision}_samples_per_sec"] = round(sps, 1)
+            detail[f"{model}_{precision}_mfu"] = round(mfu(model, sps), 4)
+    detail["word2vec_words_per_sec"] = round(measure_word2vec(), 1)
     cpu = _cpu_baseline()
+    detail["cpu_fp32_mlp_samples_per_sec"] = round(cpu, 1)
+    value = detail["mlp_bf16_samples_per_sec"]
     vs = value / cpu if cpu > 0 else 0.0
     print(json.dumps({
         "metric": "mnist_mlp_train_samples_per_sec_per_chip",
-        "value": round(value, 1),
+        "value": value,
         "unit": "samples/sec",
         "vs_baseline": round(vs, 2),
+        "detail": detail,
     }))
 
 

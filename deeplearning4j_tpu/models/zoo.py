@@ -84,14 +84,22 @@ def stacked_denoising_autoencoder(
     return b.pretrain(True).backward(True).build()
 
 
-def char_lstm(vocab: int = 64, hidden: int = 128, seed: int = 42
-              ) -> MultiLayerConfiguration:
-    """Karpathy-style char LSTM (ref: nn/layers/recurrent/LSTM.java)."""
+def char_lstm(vocab: int = 64, seed: int = 42,
+              lr: float = 0.1) -> MultiLayerConfiguration:
+    """Karpathy-style char LSTM (ref: nn/layers/recurrent/LSTM.java).
+
+    Trainable end-to-end through MultiLayerNetwork.fit(): the LSTM head's
+    decoder provides per-timestep logits; labels are (batch, time, vocab)
+    next-char one-hots, scored with per-timestep softmax cross-entropy.
+    Hidden size equals n_out (square decoder), matching the reference's
+    LSTMParamInitializer (nn/params/LSTMParamInitializer.java:39-41).
+    """
     return (
         NeuralNetConfiguration.Builder()
-        .lr(0.1).seed(seed).activation_function("tanh")
+        .lr(lr).seed(seed).activation_function("tanh")
+        .loss_function("MCXENT")
         .list(1)
-        .override(0, layer_type="LSTM", n_in=vocab, n_out=hidden)
-        .pretrain(False).backward(False)
+        .override(0, layer_type="LSTM", n_in=vocab, n_out=vocab)
+        .pretrain(False).backward(True)
         .build()
     )

@@ -102,10 +102,12 @@ class NeuralNetConfiguration:
         object.__setattr__(self, "momentum_after", _freeze_schedule(self.momentum_after))
         for f in ("filter_size", "stride", "feature_map_size"):
             object.__setattr__(self, f, tuple(int(x) for x in getattr(self, f)))
-        # fail at conf time, not first trace: a typo'd activation should raise
-        # here with the list of known names
+        # fail at conf time, not first trace: a typo'd activation or step
+        # function should raise here with the list of known names
         from deeplearning4j_tpu.ops.activations import activation as _act
         _act(self.activation_function)
+        from deeplearning4j_tpu.optimize.stepfunctions import step_function as _sf
+        _sf(self.step_function)
         if self.dist is not None:
             k, a, b = self.dist
             object.__setattr__(self, "dist", (str(k), float(a), float(b)))

@@ -110,7 +110,20 @@ def network_per_example_loss(
     ``ops.losses.finalize_loss(head.loss_function, mean(per_example))``;
     data-parallel callers weight rows (padding masks) and normalize the mean
     across shards with a psum so uneven batches stay unbiased.
+
+    Head layers:
+    - OUTPUT: fused-logits classifier head. 3-D labels (batch, time, classes)
+      are scored per timestep and averaged over time.
+    - LSTM: the layer's own decoder projection provides per-timestep logits
+      (ref: nn/layers/recurrent/LSTM.java:54-160 trains through its decoder
+      with per-timestep softmax); labels are (batch, time, vocab).
     """
+    from deeplearning4j_tpu.ops.losses import (
+        LossFunction,
+        per_example_loss,
+        per_example_loss_from_logits,
+    )
+
     n = conf.n_layers
     keys = jax.random.split(key, n) if key is not None else [None] * n
     for i in range(n - 1):
@@ -119,11 +132,26 @@ def network_per_example_loss(
                               drop_connect=conf.use_drop_connect)
     x = _maybe_preprocess(conf, n - 1, x)
     head = conf.conf(n - 1)
-    if head.layer_type != LayerType.OUTPUT:
-        raise ValueError("network_per_example_loss requires an OUTPUT head layer")
-    return output_layer.output_per_example_loss(
-        head, params[n - 1], x, labels, train=train,
-        key=keys[n - 1], drop_connect=conf.use_drop_connect)
+    if head.layer_type == LayerType.OUTPUT:
+        per = output_layer.output_per_example_loss(
+            head, params[n - 1], x, labels, train=train,
+            key=keys[n - 1], drop_connect=conf.use_drop_connect)
+    elif head.layer_type == LayerType.LSTM:
+        logits = layer_ops.forward(head, params[n - 1], x, train=train,
+                                   key=keys[n - 1]).astype(jnp.float32)
+        labels = labels.astype(jnp.float32)
+        ce_family = (LossFunction.MCXENT, LossFunction.NEGATIVELOGLIKELIHOOD,
+                     LossFunction.XENT, LossFunction.RECONSTRUCTION_CROSSENTROPY)
+        if LossFunction.coerce(head.loss_function) in ce_family:
+            per = per_example_loss_from_logits(head.loss_function, labels, logits)
+        else:
+            per = per_example_loss(head.loss_function, labels, logits)
+    else:
+        raise ValueError(
+            "network_per_example_loss requires an OUTPUT or LSTM head layer")
+    if per.ndim > 1:  # sequence head: average the per-timestep losses
+        per = jnp.mean(per, axis=tuple(range(1, per.ndim)))
+    return per
 
 
 def make_train_step(conf: MultiLayerConfiguration, donate: bool = False,

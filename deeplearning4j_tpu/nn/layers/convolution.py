@@ -30,13 +30,14 @@ def forward(
 ) -> jax.Array:
     w = params[CONV_WEIGHT_KEY]
     b = params[CONV_BIAS_KEY]
+    # the weights set the compute dtype: under a bf16 policy the conv runs on
+    # the bf16 MXU path (the MXU still accumulates in f32 internally)
     out = lax.conv_general_dilated(
-        x,
+        x.astype(w.dtype),
         w,
         window_strides=(1, 1),
         padding="VALID",
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        preferred_element_type=jnp.float32,
     )
     out = out + b[None, :, None, None]
     return activation(conf.activation_function)(out)

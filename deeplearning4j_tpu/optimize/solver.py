@@ -31,7 +31,12 @@ import jax.numpy as jnp
 from deeplearning4j_tpu.nn.api import OptimizationAlgorithm
 from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.gradient import flatten_params, unflatten_params
-from deeplearning4j_tpu.optimize.terminations import EpsTermination, ZeroDirection
+from deeplearning4j_tpu.optimize.stepfunctions import step_function
+from deeplearning4j_tpu.optimize.terminations import (
+    EpsTermination,
+    Norm2Termination,
+    ZeroDirection,
+)
 from deeplearning4j_tpu.optimize.updater import apply_updater, init_updater_state
 
 Array = jax.Array
@@ -106,7 +111,11 @@ class Solver:
                 return self._score(params, key), g(params, key)
 
             self._value_and_grad = grad_fn_custom
-        self._terminations = [EpsTermination(), ZeroDirection()]
+        self._terminations = [EpsTermination(), ZeroDirection(),
+                              Norm2Termination()]
+        # how line-search solvers apply (direction, step) to x
+        # (ref: optimize/stepfunctions/, selected by conf.step_function)
+        self._step_fn = step_function(conf.step_function)
         self.score_history: List[float] = []
 
     # ---- public API (ref: Solver.optimize) ----
@@ -208,7 +217,7 @@ class Solver:
                 step = ls(x, jnp.asarray(score), g, d, sub)
                 if float(step) == 0.0:
                     break
-            x = x + step * d
+            x = self._step_fn(x, d, step)
             g_prev = g
             old_score = score
         return unflatten_params(template, x)
@@ -303,7 +312,7 @@ class Solver:
                 lam *= 2.0 / 3.0
             elif rho < 0.25:
                 lam *= 1.5
-            x = x + step_scale * d
+            x = self._step_fn(x, d, step_scale)
             old_score = score
         return unflatten_params(template, x)
 
@@ -356,6 +365,6 @@ class Solver:
                 if float(step) == 0.0:
                     break
             x_prev, g_prev = x, g
-            x = x + step * d
+            x = self._step_fn(x, d, step)
             old_score = score
         return unflatten_params(template, x)

@@ -1,0 +1,273 @@
+"""Pluggable blob store — the remote-storage seam.
+
+Parity surface: the reference's cloud/remote IO modules —
+S3Downloader/S3Uploader/S3ModelSaver/BaseS3DataSetIterator
+(ref: deeplearning4j-scaleout/deeplearning4j-aws/src/main/java/org/deeplearning4j/aws/s3/)
+and HdfsModelSaver/BaseHdfsDataSetIterator/HdfsUtils
+(ref: deeplearning4j-scaleout "hadoop" module). A TPU-pod framework needs the
+same seam shaped for object stores (GCS): flat keys in a bucket, whole-object
+get/put, list-by-prefix.
+
+Everything above the seam (ModelSaver, checkpoints, DataSet iteration) talks
+to the abstract ``BlobStore``; backends plug in below it. The local-directory
+and in-memory backends are fully functional; the GCS backend carries the
+real URI scheme and fails with a clear message when the client library is
+absent (this environment has no egress).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import re
+from typing import Dict, List, Optional
+
+import numpy as np
+
+_KEY_RE = re.compile(r"^[A-Za-z0-9._/\-]+$")
+
+
+def _check_key(key: str) -> str:
+    """Reject traversal and absolute keys (same discipline as the config
+    registry, scaleout/registry.py)."""
+    if not key or not _KEY_RE.match(key) or key.startswith("/") or ".." in key.split("/"):
+        raise ValueError(f"invalid blob key {key!r}")
+    return key
+
+
+class BlobStore:
+    """GCS-shaped object store: flat keys, whole-object get/put."""
+
+    def put(self, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def exists(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+    def list(self, prefix: str = "") -> List[str]:
+        raise NotImplementedError
+
+
+class LocalBlobStore(BlobStore):
+    """Objects as files under a root directory (the reference's Hdfs/S3 tests
+    run against local filesystems the same way)."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, _check_key(key))
+
+    def put(self, key: str, data: bytes) -> None:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)  # atomic publish
+
+    def get(self, key: str) -> bytes:
+        with open(self._path(key), "rb") as f:
+            return f.read()
+
+    def exists(self, key: str) -> bool:
+        return os.path.isfile(self._path(key))
+
+    def delete(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    def list(self, prefix: str = "") -> List[str]:
+        out = []
+        for dirpath, _, files in os.walk(self.root):
+            for name in files:
+                if name.endswith(".tmp"):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, name), self.root)
+                rel = rel.replace(os.sep, "/")
+                if rel.startswith(prefix):
+                    out.append(rel)
+        return sorted(out)
+
+
+class InMemoryBlobStore(BlobStore):
+    def __init__(self):
+        self._data: Dict[str, bytes] = {}
+
+    def put(self, key: str, data: bytes) -> None:
+        self._data[_check_key(key)] = bytes(data)
+
+    def get(self, key: str) -> bytes:
+        return self._data[key]
+
+    def exists(self, key: str) -> bool:
+        return key in self._data
+
+    def delete(self, key: str) -> None:
+        self._data.pop(key, None)
+
+    def list(self, prefix: str = "") -> List[str]:
+        return sorted(k for k in self._data if k.startswith(prefix))
+
+
+class GCSBlobStore(BlobStore):
+    """Google Cloud Storage backend (the TPU-native analogue of the
+    reference's S3 module). Requires google-cloud-storage at runtime; this
+    build environment has no egress, so construction fails loudly rather
+    than pretending."""
+
+    def __init__(self, bucket: str, prefix: str = ""):
+        try:
+            from google.cloud import storage  # type: ignore
+        except ImportError as exc:  # pragma: no cover - environment-dependent
+            raise RuntimeError(
+                "GCSBlobStore requires the google-cloud-storage package; "
+                "use file:// or mem:// stores in environments without it"
+            ) from exc
+        self._bucket = storage.Client().bucket(bucket)  # pragma: no cover
+        self.prefix = prefix.strip("/")  # pragma: no cover
+
+    def _name(self, key: str) -> str:  # pragma: no cover
+        key = _check_key(key)
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    def put(self, key: str, data: bytes) -> None:  # pragma: no cover
+        self._bucket.blob(self._name(key)).upload_from_string(data)
+
+    def get(self, key: str) -> bytes:  # pragma: no cover
+        return self._bucket.blob(self._name(key)).download_as_bytes()
+
+    def exists(self, key: str) -> bool:  # pragma: no cover
+        return self._bucket.blob(self._name(key)).exists()
+
+    def delete(self, key: str) -> None:  # pragma: no cover
+        self._bucket.blob(self._name(key)).delete()
+
+    def list(self, prefix: str = "") -> List[str]:  # pragma: no cover
+        full = self._name(prefix) if prefix else self.prefix
+        names = [b.name for b in self._bucket.list_blobs(prefix=full)]
+        cut = len(self.prefix) + 1 if self.prefix else 0
+        return sorted(n[cut:] for n in names)
+
+
+def open_store(uri: str) -> BlobStore:
+    """URI scheme → store (parity with the CLI's URI Scheme registry,
+    ref: cli/api/schemes/): file:///dir, mem://, gs://bucket/prefix."""
+    if uri.startswith("file://"):
+        return LocalBlobStore(uri[len("file://"):])
+    if uri.startswith("mem://"):
+        return InMemoryBlobStore()
+    if uri.startswith("gs://"):
+        rest = uri[len("gs://"):]
+        bucket, _, prefix = rest.partition("/")
+        return GCSBlobStore(bucket, prefix)
+    # bare paths are local directories
+    return LocalBlobStore(uri)
+
+
+# --------------------------------------------------------------- adapters ----
+
+class BlobModelSaver:
+    """ModelSaver over a BlobStore (ref: S3ModelSaver / HdfsModelSaver)."""
+
+    def __init__(self, store: BlobStore, key: str = "nn-model.npz"):
+        self.store = store
+        self.key = key
+
+    def save(self, model) -> None:
+        buf = io.BytesIO()
+        np.savez(
+            buf,
+            params=np.asarray(model.params()),
+            conf=np.frombuffer(model.conf.to_json().encode(), dtype=np.uint8),
+        )
+        self.store.put(self.key, buf.getvalue())
+
+    def load(self):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        with np.load(io.BytesIO(self.store.get(self.key))) as z:
+            from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+
+            conf = MultiLayerConfiguration.from_json(bytes(z["conf"]).decode())
+            net = MultiLayerNetwork(conf).init()
+            net.set_params(z["params"])
+        return net
+
+    def exists(self) -> bool:
+        return self.store.exists(self.key)
+
+
+def save_checkpoint_to_store(store: BlobStore, key: str, net,
+                             iteration: Optional[int] = None) -> str:
+    """Full-state checkpoint (params + updater state + iteration + RNG)
+    through the blob seam; same payload as scaleout/checkpoint.py."""
+    import tempfile
+
+    from deeplearning4j_tpu.scaleout.checkpoint import save_checkpoint
+
+    with tempfile.TemporaryDirectory() as d:
+        path = save_checkpoint(os.path.join(d, "ckpt"), net, iteration)
+        with open(path, "rb") as f:
+            store.put(key, f.read())
+    return key
+
+
+def load_checkpoint_from_store(store: BlobStore, key: str):
+    import tempfile
+
+    from deeplearning4j_tpu.scaleout.checkpoint import load_checkpoint
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "ckpt.npz")
+        with open(path, "wb") as f:
+            f.write(store.get(key))
+        return load_checkpoint(path)
+
+
+class BlobDataSetIterator:
+    """DataSet batches from serialized npz blobs under a key prefix
+    (ref: BaseS3DataSetIterator / BaseHdfsDataSetIterator). Each blob holds
+    one batch: arrays ``features`` and ``labels``."""
+
+    def __init__(self, store: BlobStore, prefix: str = ""):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        self._DataSet = DataSet
+        self.store = store
+        self.keys = store.list(prefix)
+        self._pos = 0
+
+    @staticmethod
+    def write_batch(store: BlobStore, key: str, features, labels) -> None:
+        buf = io.BytesIO()
+        np.savez(buf, features=np.asarray(features), labels=np.asarray(labels))
+        store.put(key, buf.getvalue())
+
+    def has_next(self) -> bool:
+        return self._pos < len(self.keys)
+
+    def next(self, num=None):
+        if not self.has_next():
+            raise StopIteration
+        key = self.keys[self._pos]
+        self._pos += 1
+        with np.load(io.BytesIO(self.store.get(key))) as z:
+            return self._DataSet(z["features"], z["labels"])
+
+    def reset(self) -> None:
+        self._pos = 0
+
+    def __iter__(self):
+        self.reset()
+        while self.has_next():
+            yield self.next()

@@ -1,0 +1,211 @@
+"""Distributed NLP work performers — Word2Vec / GloVe on the scaleout runner.
+
+Parity surface: the reference trains embeddings on the cluster through
+WorkerPerformers in three transports —
+- Akka: scaleout/perform/models/word2vec/Word2VecPerformer.java (skip-gram
+  worker with exp table, shared lr decay via the tracker counter
+  NUM_WORDS_SO_FAR) + Word2VecJobAggregator,
+- Spark: dl4j-spark-nlp .../word2vec/Word2VecPerformer.java,
+- YARN: hadoop/nlp models/{word2vec,glove} performers.
+
+TPU-first redesign: workers keep full embedding matrices as a flat param
+vector (the reference ships per-word vector slices in Word2VecWork jobs —
+a host-serialization concern XLA removes), train each job's pair batch with
+the SAME jitted batched steps the local models use (_sgns_step /
+_glove_step), and the standard ParameterAveragingAggregator averages worker
+vectors per IterativeReduce round. The shared lr decay counter keeps the
+reference's NUM_WORDS_SO_FAR semantics. On real silicon prefer the in-graph
+mesh path (models/word2vec.py make_sharded_sgns_step); this is the
+control-plane-parity path.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.scaleout.job import Job, JobIterator
+from deeplearning4j_tpu.scaleout.perform import WorkerPerformer
+from deeplearning4j_tpu.text.vocab import VocabCache
+
+NUM_WORDS_SO_FAR = "num_words_so_far"  # ref: Word2VecPerformer counter name
+
+
+class Word2VecWorkPerformer(WorkerPerformer):
+    """Skip-gram negative-sampling worker.
+
+    Job.work = (centers, contexts) int32 arrays (one pair batch).
+    Result = flat params: concat(syn0.ravel(), syn1neg.ravel()).
+    update(flat) installs the averaged master vector.
+    """
+
+    def __init__(self, vocab: VocabCache, layer_size: int = 50,
+                 negative: int = 5, lr: float = 0.025, min_lr: float = 1e-4,
+                 total_words: Optional[int] = None, tracker=None,
+                 seed: int = 123):
+        from deeplearning4j_tpu.models.embeddings import InMemoryLookupTable
+        from deeplearning4j_tpu.models.word2vec import _sgns_step
+
+        self.vocab = vocab
+        self.layer_size = layer_size
+        self.negative = negative
+        self.lr = lr
+        self.min_lr = min_lr
+        self.total_words = total_words
+        self.tracker = tracker
+        self._step = _sgns_step
+        table = InMemoryLookupTable(vocab, layer_size, seed=seed,
+                                    use_hs=False, negative=negative)
+        self._syn0 = jnp.asarray(table.syn0)
+        self._syn1neg = jnp.asarray(table.syn1neg)
+        self._probs_logits = jnp.log(jnp.asarray(table.unigram_probs()) + 1e-12)
+        self._key = jax.random.PRNGKey(seed)
+        self._words_local = 0
+
+    @property
+    def vocab_size(self) -> int:
+        return self.vocab.num_words()
+
+    def _current_lr(self) -> float:
+        """Linear decay by GLOBAL words seen — shared across workers via the
+        tracker counter (ref: Word2VecPerformer NUM_WORDS_SO_FAR)."""
+        if self.total_words is None:
+            return self.lr
+        seen = (self.tracker.count(NUM_WORDS_SO_FAR)
+                if self.tracker is not None else self._words_local)
+        frac = min(float(seen) / max(self.total_words, 1), 1.0)
+        return max(self.min_lr, self.lr * (1.0 - frac))
+
+    def perform(self, job: Job) -> None:
+        centers, contexts = job.work
+        centers = np.asarray(centers, np.int32)
+        contexts = np.asarray(contexts, np.int32)
+        weights = np.ones(centers.shape[0], np.float32)
+        lr = self._current_lr()
+        self._key, sub = jax.random.split(self._key)
+        # non-donating call: the performer's arrays survive for the next job
+        self._syn0, self._syn1neg, _ = self._step(
+            jnp.array(self._syn0), jnp.array(self._syn1neg),
+            jnp.asarray(centers), jnp.asarray(contexts), jnp.asarray(weights),
+            self._probs_logits, jnp.float32(lr), sub, negative=self.negative,
+        )
+        n = int(centers.shape[0])
+        self._words_local += n
+        if self.tracker is not None:
+            self.tracker.increment(NUM_WORDS_SO_FAR, n)
+        job.result = np.concatenate([
+            np.asarray(self._syn0).ravel(),
+            np.asarray(self._syn1neg).ravel(),
+        ])
+
+    def update(self, *args) -> None:
+        if not args:
+            return
+        flat = np.asarray(args[0], np.float32)
+        v, d = self.vocab_size, self.layer_size
+        self._syn0 = jnp.asarray(flat[: v * d].reshape(v, d))
+        self._syn1neg = jnp.asarray(flat[v * d:].reshape(v, d))
+
+    # query helpers for tests / model extraction
+    def syn0(self) -> np.ndarray:
+        return np.asarray(self._syn0)
+
+
+class GloveWorkPerformer(WorkerPerformer):
+    """GloVe worker (ref: scaleout/perform/models/glove/GlovePerformer.java).
+
+    Job.work = (rows, cols, logx, fx) arrays (one co-occurrence batch).
+    Result = flat params: concat(w.ravel(), bias). AdaGrad accumulators stay
+    worker-local (the reference averages parameter vectors only).
+    """
+
+    def __init__(self, vocab_size: int, layer_size: int = 50,
+                 lr: float = 0.05, seed: int = 123):
+        from deeplearning4j_tpu.models.glove import _glove_step
+
+        self.vocab_size = vocab_size
+        self.layer_size = layer_size
+        self.lr = lr
+        self._step = _glove_step
+        rng = np.random.default_rng(seed)
+        self._w = jnp.asarray(
+            (rng.random((vocab_size, layer_size), np.float32) - 0.5) / layer_size)
+        self._b = jnp.zeros((vocab_size,), jnp.float32)
+        self._hw = jnp.zeros((vocab_size, layer_size), jnp.float32)
+        self._hb = jnp.zeros((vocab_size,), jnp.float32)
+
+    def perform(self, job: Job) -> None:
+        rows, cols, logx, fx = job.work
+        weights = np.ones(len(rows), np.float32)
+        self._w, self._b, self._hw, self._hb, _ = self._step(
+            jnp.array(self._w), jnp.array(self._b),
+            jnp.array(self._hw), jnp.array(self._hb),
+            jnp.asarray(rows, jnp.int32), jnp.asarray(cols, jnp.int32),
+            jnp.asarray(logx, jnp.float32), jnp.asarray(fx, jnp.float32),
+            jnp.asarray(weights), jnp.float32(self.lr),
+        )
+        job.result = np.concatenate(
+            [np.asarray(self._w).ravel(), np.asarray(self._b)])
+
+    def update(self, *args) -> None:
+        if not args:
+            return
+        flat = np.asarray(args[0], np.float32)
+        v, d = self.vocab_size, self.layer_size
+        self._w = jnp.asarray(flat[: v * d].reshape(v, d))
+        self._b = jnp.asarray(flat[v * d:])
+
+    def syn0(self) -> np.ndarray:
+        return np.asarray(self._w)
+
+
+class SkipGramJobIterator(JobIterator):
+    """Slices a (centers, contexts) pair stream into fixed-size pair-batch
+    jobs (the reference batches sentences into Word2VecWork jobs)."""
+
+    def __init__(self, centers: np.ndarray, contexts: np.ndarray,
+                 batch_size: int = 2048):
+        self._c = np.asarray(centers, np.int32)
+        self._t = np.asarray(contexts, np.int32)
+        self._bsz = batch_size
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._c)
+
+    def next(self, worker_id: str = "") -> Job:
+        lo, hi = self._pos, min(self._pos + self._bsz, len(self._c))
+        self._pos = hi
+        return Job((self._c[lo:hi], self._t[lo:hi]), worker_id)
+
+    def reset(self) -> None:
+        self._pos = 0
+
+
+class CoOccurrenceJobIterator(JobIterator):
+    """Slices a GloVe co-occurrence list into fixed-size batch jobs."""
+
+    def __init__(self, rows, cols, vals, x_max: float = 100.0,
+                 alpha: float = 0.75, batch_size: int = 4096):
+        self._rows = np.asarray(rows, np.int32)
+        self._cols = np.asarray(cols, np.int32)
+        vals = np.asarray(vals, np.float32)
+        self._logx = np.log(np.maximum(vals, 1e-12)).astype(np.float32)
+        self._fx = np.minimum((vals / x_max) ** alpha, 1.0).astype(np.float32)
+        self._bsz = batch_size
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._rows)
+
+    def next(self, worker_id: str = "") -> Job:
+        lo, hi = self._pos, min(self._pos + self._bsz, len(self._rows))
+        self._pos = hi
+        return Job((self._rows[lo:hi], self._cols[lo:hi],
+                    self._logx[lo:hi], self._fx[lo:hi]), worker_id)
+
+    def reset(self) -> None:
+        self._pos = 0

@@ -139,3 +139,64 @@ class TestHessianFree:
         net.finetune(x.features, x.labels)
         s1 = net.score(x.features, x.labels)
         assert s1 < s0, (s0, s1)
+
+
+class TestStepFunctions:
+    """ref: optimize/stepfunctions/ + nn/conf/stepfunctions/ — the conf's
+    step_function field selects how line-search solvers apply (direction,
+    step) to the parameter vector."""
+
+    def test_registry_semantics(self):
+        import jax.numpy as jnp
+        import numpy as np
+        from deeplearning4j_tpu.optimize.stepfunctions import step_function
+
+        x = jnp.asarray([1.0, 2.0])
+        d = jnp.asarray([0.5, -0.5])
+        np.testing.assert_allclose(step_function("default")(x, d, 2.0), [2.0, 1.0])
+        np.testing.assert_allclose(step_function("negative_default")(x, d, 2.0), [0.0, 3.0])
+        np.testing.assert_allclose(step_function("gradient")(x, d, 2.0), [1.5, 1.5])
+        np.testing.assert_allclose(step_function("negative_gradient")(x, d, 2.0), [0.5, 2.5])
+
+    def test_unknown_name_raises_at_conf_time(self):
+        import pytest
+        with pytest.raises(ValueError, match="step function"):
+            NeuralNetConfiguration(step_function="sideways")
+
+    def test_negative_default_ascends(self):
+        """CG with negative_default flips descent into ascent (maximization
+        parity with the reference's negative step functions)."""
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.api import OptimizationAlgorithm
+        from deeplearning4j_tpu.optimize.solver import Solver
+
+        def score(params, key):
+            x = params["x"]
+            return jnp.sum((x - 3.0) ** 2)
+
+        conf = NeuralNetConfiguration(n_in=1, n_out=1, num_iterations=4,
+                                      step_function="negative_default")
+        solver = Solver(conf, score, num_iterations=4)
+        out = solver.optimize({"x": jnp.zeros(3, jnp.float32)},
+                              jax.random.PRNGKey(0),
+                              algo=OptimizationAlgorithm.CONJUGATE_GRADIENT)
+        # moved AWAY from the minimum: score increased
+        assert float(score(out, None)) > float(score({"x": jnp.zeros(3)}, None))
+
+    def test_norm2_termination_stops_at_minimum(self):
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.api import OptimizationAlgorithm
+        from deeplearning4j_tpu.optimize.solver import Solver
+
+        def score(params, key):
+            return jnp.sum(params["x"] ** 2)
+
+        conf = NeuralNetConfiguration(n_in=1, n_out=1, num_iterations=50)
+        solver = Solver(conf, score, num_iterations=50)
+        solver.optimize({"x": jnp.zeros(3, jnp.float32)},
+                        jax.random.PRNGKey(0),
+                        algo=OptimizationAlgorithm.CONJUGATE_GRADIENT)
+        # grad norm 0 at the start point → Norm2/ZeroDirection stop on iter 0
+        assert len(solver.score_history) == 1

@@ -1,0 +1,66 @@
+"""bf16 mixed-precision parity gates (ops/dtypes.py Policy).
+
+The TPU bench runs with bf16 compute + fp32 master params; these tests gate
+that policy against fp32: same conf, same data, same seeds — final loss and
+accuracy must match within tolerance. (The reference is fp32-only through
+ND4J; mixed precision is the TPU-idiomatic addition.)
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datasets.fetchers import synthetic_mnist
+from deeplearning4j_tpu.models.zoo import lenet, mnist_mlp
+from deeplearning4j_tpu.nn import functional as F
+from deeplearning4j_tpu.ops.dtypes import BF16_COMPUTE
+
+
+def _train(conf, policy, x, y, steps):
+    params = F.init_params(conf, jax.random.PRNGKey(0))
+    states = F.init_train_state(conf, params)
+    epoch = F.make_train_epoch(conf, steps, donate=False, policy=policy)
+    params, states, scores = epoch(
+        params, states, jnp.asarray(0), x, y, jax.random.PRNGKey(1)
+    )
+    return params, np.asarray(scores)
+
+
+def _accuracy(conf, params, x, y):
+    out = F.output(conf, params, x.reshape(-1, x.shape[-1]))
+    pred = np.argmax(np.asarray(out), axis=-1)
+    truth = np.argmax(np.asarray(y.reshape(-1, y.shape[-1])), axis=-1)
+    return float((pred == truth).mean())
+
+
+class TestBF16Parity:
+    def test_mlp_loss_and_accuracy_parity(self):
+        steps, batch = 30, 128
+        conf = mnist_mlp(64, 32)
+        xs, ys = synthetic_mnist(batch * steps)
+        x = jnp.asarray(xs).reshape(steps, batch, -1)
+        y = jax.nn.one_hot(jnp.asarray(ys), 10, dtype=jnp.float32).reshape(
+            steps, batch, -1
+        )
+        p32, s32 = _train(conf, None, x, y, steps)
+        p16, s16 = _train(conf, BF16_COMPUTE, x, y, steps)
+        # master params stay fp32 under the bf16 policy
+        assert all(v.dtype == jnp.float32 for layer in p16 for v in layer.values())
+        # loss curves track each other
+        assert abs(s32[-1] - s16[-1]) < 0.08, (s32[-1], s16[-1])
+        a32 = _accuracy(conf, p32, x, y)
+        a16 = _accuracy(conf, p16, x, y)
+        assert abs(a32 - a16) < 0.05, (a32, a16)
+        assert a16 > 0.5, a16  # genuinely learned, not just matched
+
+    def test_lenet_bf16_trains(self):
+        steps, batch = 10, 64
+        conf = lenet()
+        xs, ys = synthetic_mnist(batch * steps)
+        x = jnp.asarray(xs).reshape(steps, batch, -1)
+        y = jax.nn.one_hot(jnp.asarray(ys), 10, dtype=jnp.float32).reshape(
+            steps, batch, -1
+        )
+        p16, s16 = _train(conf, BF16_COMPUTE, x, y, steps)
+        assert np.isfinite(s16).all()
+        assert s16[-1] < s16[0], (s16[0], s16[-1])

@@ -1,0 +1,112 @@
+"""Distributed NLP training through the scaleout runner (ref test model:
+DistributedWord2VecTest / DistributedGloveTest over the in-JVM Akka harness,
+SURVEY.md §4)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.models.word2vec import Word2Vec
+from deeplearning4j_tpu.scaleout.nlp_perform import (
+    NUM_WORDS_SO_FAR,
+    CoOccurrenceJobIterator,
+    GloveWorkPerformer,
+    SkipGramJobIterator,
+    Word2VecWorkPerformer,
+)
+from deeplearning4j_tpu.scaleout.runner import LocalDistributedRunner
+from deeplearning4j_tpu.scaleout.statetracker import InMemoryStateTracker
+from deeplearning4j_tpu.text.sentence_iterator import CollectionSentenceIterator
+
+
+def _toy_corpus():
+    fruit = "apple banana cherry fruit sweet juice"
+    tech = "cpu gpu chip silicon compute memory"
+    sents = []
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        sents.append(" ".join(rng.permutation(fruit.split()).tolist()))
+        sents.append(" ".join(rng.permutation(tech.split()).tolist()))
+    return sents
+
+
+def _cosine(a, b):
+    return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
+
+
+class TestDistributedWord2Vec:
+    def test_runner_trains_embeddings(self):
+        # build vocab + pair stream with the model's own pipeline
+        w2v = Word2Vec(
+            sentence_iterator=CollectionSentenceIterator(_toy_corpus()),
+            layer_size=16, window=3, negative=5, sample=0, seed=1,
+        )
+        w2v.build_vocab()
+        rng = np.random.default_rng(1)
+        all_c, all_t = [], []
+        for _ in range(10):  # epochs of pairs
+            sents = w2v._sentence_indices(rng)
+            rng.shuffle(sents)
+            c, t = w2v._skipgram_pairs(sents, rng)
+            all_c.append(c)
+            all_t.append(t)
+        centers = np.concatenate(all_c)
+        contexts = np.concatenate(all_t)
+
+        tracker = InMemoryStateTracker()
+        vocab = w2v.vocab
+        runner = LocalDistributedRunner(
+            performer_factory=lambda: Word2VecWorkPerformer(
+                vocab, layer_size=16, negative=5, lr=0.1,
+                total_words=len(centers), tracker=tracker, seed=1,
+            ),
+            job_iterator=SkipGramJobIterator(centers, contexts, 2048),
+            num_workers=4,
+            tracker=tracker,
+        )
+        flat = runner.train()
+        assert flat is not None
+        v, d = vocab.num_words(), 16
+        syn0 = flat[: v * d].reshape(v, d)
+
+        def vec(w):
+            return syn0[vocab.index_of(w)]
+
+        same = _cosine(vec("apple"), vec("banana"))
+        cross = _cosine(vec("apple"), vec("gpu"))
+        assert same > cross, (same, cross)
+        # the shared lr-decay counter advanced across workers
+        assert tracker.count(NUM_WORDS_SO_FAR) == len(centers)
+
+
+class TestDistributedGlove:
+    def test_runner_trains_glove(self):
+        from deeplearning4j_tpu.models.glove import Glove
+
+        g = Glove(
+            sentence_iterator=CollectionSentenceIterator(_toy_corpus()),
+            layer_size=16, window=5, iterations=1, seed=1,
+        )
+        g.build_vocab_and_cooccurrences()
+        rows, cols, vals = g.co.to_arrays()
+        # several epochs of co-occurrence batches, shuffled
+        rng = np.random.default_rng(2)
+        order = np.concatenate(
+            [rng.permutation(len(rows)) for _ in range(30)])
+
+        runner = LocalDistributedRunner(
+            performer_factory=lambda: GloveWorkPerformer(
+                g.vocab.num_words(), layer_size=16, lr=0.05, seed=1),
+            job_iterator=CoOccurrenceJobIterator(
+                rows[order], cols[order], vals[order], batch_size=4096),
+            num_workers=4,
+        )
+        flat = runner.train()
+        assert flat is not None
+        v, d = g.vocab.num_words(), 16
+        w = flat[: v * d].reshape(v, d)
+
+        def vec(word):
+            return w[g.vocab.index_of(word)]
+
+        same = _cosine(vec("apple"), vec("banana"))
+        cross = _cosine(vec("apple"), vec("gpu"))
+        assert same > cross, (same, cross)
