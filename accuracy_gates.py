@@ -1,0 +1,200 @@
+"""Real-data accuracy gates (BASELINE north star: "train to reference accuracy").
+
+The reference proves model quality by downloading MNIST and training to
+accuracy (ref: datasets/fetchers/MnistDataFetcher.java:39-85, examples in
+MultiLayerTest). This environment has no egress, so the gates run on the real
+datasets that ARE available locally:
+
+- Fisher's Iris (embedded, the same 150-sample data the reference ships as
+  iris.dat in dl4j-test-resources),
+- the UCI handwritten digits set bundled with scikit-learn (1,797 genuine
+  8x8 scans — the closest real MNIST-class data available offline).
+
+MNIST-sized gates additionally run on the synthetic MNIST surrogate and are
+LABELED synthetic — they are convergence proofs for the 784-input configs,
+never claimed as real-data accuracy. Real-MNIST gates are recorded as
+``pending`` with the reason.
+
+Run:  python accuracy_gates.py  →  prints JSON and writes ACCURACY_r02.json
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _split(x: np.ndarray, y: np.ndarray, n_train: int, seed: int = 0):
+    perm = np.random.default_rng(seed).permutation(x.shape[0])
+    x, y = x[perm], y[perm]
+    return (x[:n_train], y[:n_train]), (x[n_train:], y[n_train:])
+
+
+def _one_hot(y: np.ndarray, k: int) -> np.ndarray:
+    out = np.zeros((y.shape[0], k), np.float32)
+    out[np.arange(y.shape[0]), y] = 1.0
+    return out
+
+
+def _accuracy(net, x: np.ndarray, y: np.ndarray) -> float:
+    from deeplearning4j_tpu.eval import Evaluation
+
+    ev = Evaluation()
+    ev.eval(_one_hot(y, int(y.max()) + 1), np.asarray(net.label_probabilities(x)))
+    return ev.accuracy()
+
+
+def gate_iris(epochs: int = 300, threshold: float = 0.93) -> dict:
+    """MLP on real Iris, 120/30 split."""
+    from deeplearning4j_tpu.datasets.fetchers import iris_data
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    x, y = iris_data()
+    (xtr, ytr), (xte, yte) = _split(x, y, 120)
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .n_in(4).n_out(16).activation_function("tanh")
+        .lr(0.05).momentum(0.9).use_ada_grad(True)
+        .num_iterations(1).seed(42).weight_init("VI")
+        .list(2)
+        .override(1, layer_type="OUTPUT", n_in=16, n_out=3,
+                  activation_function="softmax", loss_function="MCXENT")
+        .pretrain(False).backward(True).build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    t0 = time.perf_counter()
+    net.fit_epochs(xtr, num_epochs=epochs, labels=_one_hot(ytr, 3))
+    wall = time.perf_counter() - t0
+    acc = _accuracy(net, xte, yte)
+    return {"gate": "iris_mlp", "dataset": "iris (real, Fisher 1936, embedded)",
+            "provenance": "real", "test_accuracy": round(acc, 4),
+            "threshold": threshold, "passed": acc >= threshold,
+            "train_wall_sec": round(wall, 2)}
+
+
+def _run_digits(conf_fn, name: str, epochs: int, threshold: float,
+                batch_size: int = 128, **conf_kw) -> dict:
+    from deeplearning4j_tpu.datasets.fetchers import digits_data
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    x, y = digits_data()
+    (xtr, ytr), (xte, yte) = _split(x, y, 1500)
+    net = MultiLayerNetwork(conf_fn(**conf_kw)).init()
+    t0 = time.perf_counter()
+    net.fit_epochs(xtr, num_epochs=epochs, labels=_one_hot(ytr, 10),
+                   batch_size=batch_size)
+    wall = time.perf_counter() - t0
+    acc = _accuracy(net, xte, yte)
+    return {"gate": name,
+            "dataset": "sklearn digits (real, UCI optdigits 8x8, 1797 scans)",
+            "provenance": "real", "test_accuracy": round(acc, 4),
+            "threshold": threshold, "passed": acc >= threshold,
+            "train_wall_sec": round(wall, 2)}
+
+
+def gate_digits_mlp(epochs: int = 40, threshold: float = 0.96) -> dict:
+    from deeplearning4j_tpu.models.zoo import digits_mlp
+
+    return _run_digits(digits_mlp, "digits_mlp", epochs, threshold)
+
+
+def gate_digits_conv(epochs: int = 40, threshold: float = 0.96) -> dict:
+    from deeplearning4j_tpu.models.zoo import digits_conv
+
+    return _run_digits(digits_conv, "digits_conv", epochs, threshold)
+
+
+def gate_sda_digits(threshold: float = 0.90) -> dict:
+    """Stacked denoising AE pretrain+finetune+backprop on real digits —
+    the wall-clock-to-accuracy protocol of BASELINE config #3
+    (ref workflow: MultiLayerNetwork.java:150-191)."""
+    from deeplearning4j_tpu.datasets.fetchers import digits_data
+    from deeplearning4j_tpu.models.zoo import stacked_denoising_autoencoder
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    x, y = digits_data()
+    (xtr, ytr), (xte, yte) = _split(x, y, 1500)
+    conf = stacked_denoising_autoencoder(
+        n_in=64, hidden=(96, 48), n_out=10, corruption_level=0.2,
+        lr=0.1, num_iterations=15,
+    )
+    net = MultiLayerNetwork(conf).init()
+    t0 = time.perf_counter()
+    net.fit(xtr, labels=_one_hot(ytr, 10), batch_size=250)  # pretrain+finetune+bp
+    net.fit_epochs(xtr, num_epochs=30, labels=_one_hot(ytr, 10), batch_size=128)
+    wall = time.perf_counter() - t0
+    acc = _accuracy(net, xte, yte)
+    return {"gate": "sda_digits",
+            "dataset": "sklearn digits (real, UCI optdigits 8x8, 1797 scans)",
+            "provenance": "real", "test_accuracy": round(acc, 4),
+            "threshold": threshold, "passed": acc >= threshold,
+            "wall_clock_to_accuracy_sec": round(wall, 2)}
+
+
+def _run_synthetic_mnist(conf_fn, name: str, epochs: int, threshold: float,
+                         n: int = 6000, n_train: int = 5000) -> dict:
+    from deeplearning4j_tpu.datasets.fetchers import synthetic_mnist
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    x, y = synthetic_mnist(n)
+    (xtr, ytr), (xte, yte) = _split(x, y, n_train)
+    net = MultiLayerNetwork(conf_fn()).init()
+    t0 = time.perf_counter()
+    net.fit_epochs(xtr, num_epochs=epochs, labels=_one_hot(ytr, 10),
+                   batch_size=256)
+    wall = time.perf_counter() - t0
+    acc = _accuracy(net, xte, yte)
+    return {"gate": name, "dataset": "synthetic_mnist (SYNTHETIC surrogate)",
+            "provenance": "synthetic",
+            "note": "convergence proof only — NOT a real-data accuracy claim",
+            "test_accuracy": round(acc, 4), "threshold": threshold,
+            "passed": acc >= threshold, "train_wall_sec": round(wall, 2)}
+
+
+def gate_mnist_mlp_synthetic(epochs: int = 5, threshold: float = 0.97) -> dict:
+    from deeplearning4j_tpu.models.zoo import mnist_mlp
+
+    return _run_synthetic_mnist(mnist_mlp, "mnist_mlp_synthetic", epochs, threshold)
+
+
+def gate_lenet_synthetic(epochs: int = 2, threshold: float = 0.97) -> dict:
+    from deeplearning4j_tpu.models.zoo import lenet
+
+    return _run_synthetic_mnist(lenet, "lenet_synthetic", epochs, threshold,
+                                n=4000, n_train=3200)
+
+
+PENDING = [
+    {"gate": "mnist_mlp_real", "reason": "MNIST IDX files absent and no "
+     "network egress; fetcher auto-uses them at $MNIST_DIR or ~/MNIST when "
+     "present (datasets/fetchers.py)"},
+    {"gate": "lenet_mnist_real", "reason": "same — real-MNIST gate pending "
+     "dataset availability"},
+]
+
+
+def main() -> None:
+    gates = [
+        gate_iris(),
+        gate_digits_mlp(),
+        gate_digits_conv(),
+        gate_sda_digits(),
+        gate_mnist_mlp_synthetic(),
+        gate_lenet_synthetic(),
+    ]
+    out = {
+        "real_data_gates": [g for g in gates if g["provenance"] == "real"],
+        "synthetic_gates": [g for g in gates if g["provenance"] == "synthetic"],
+        "pending": PENDING,
+        "all_passed": all(g["passed"] for g in gates),
+    }
+    with open("ACCURACY_r02.json", "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -212,6 +212,35 @@ class IrisDataFetcher(BaseDataFetcher):
         super().__init__(x, _one_hot(y, 3))
 
 
+def digits_data(normalize: bool = True) -> Tuple[np.ndarray, np.ndarray]:
+    """Real handwritten-digit data: scikit-learn's bundled UCI ``digits`` set
+    (1,797 genuine 8x8 grayscale scans, Alpaydin & Kaynak 1998). The closest
+    real MNIST-class data available without network egress; used for the
+    real-data accuracy gates (ACCURACY_r*.json) that the reference satisfies
+    by downloading MNIST (ref: datasets/fetchers/MnistDataFetcher.java:39-85).
+
+    Raises ImportError when scikit-learn is absent.
+    """
+    from sklearn.datasets import load_digits
+
+    bunch = load_digits()
+    x = bunch.data.astype(np.float32)
+    if normalize:
+        x /= 16.0  # pixel range is 0..16
+    return x, bunch.target.astype(np.int64)
+
+
+class DigitsDataFetcher(BaseDataFetcher):
+    """Fetcher over the real sklearn digits set (see :func:`digits_data`)."""
+
+    def __init__(self, normalize: bool = True, shuffle_seed: Optional[int] = 42):
+        x, y = digits_data(normalize)
+        if shuffle_seed is not None:
+            perm = np.random.default_rng(shuffle_seed).permutation(x.shape[0])
+            x, y = x[perm], y[perm]
+        super().__init__(x, _one_hot(y, 10))
+
+
 class CurvesDataFetcher(BaseDataFetcher):
     """Synthetic smooth-curves set (the reference downloads a curves.ser blob,
     ref: datasets/fetchers/CurvesDataFetcher.java; regenerated here as random

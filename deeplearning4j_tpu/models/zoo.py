@@ -58,6 +58,47 @@ def lenet(lr: float = 0.05, num_iterations: int = 1, seed: int = 42
     )
 
 
+def digits_mlp(hidden: int = 128, lr: float = 0.1, num_iterations: int = 1,
+               seed: int = 42) -> MultiLayerConfiguration:
+    """MLP for the real 8x8 sklearn digits set (64-h-10), used by the
+    real-data accuracy gates (datasets/fetchers.py digits_data)."""
+    return (
+        NeuralNetConfiguration.Builder()
+        .n_in(64).n_out(hidden).activation_function("relu")
+        .lr(lr).momentum(0.9).use_ada_grad(False)
+        .num_iterations(num_iterations).seed(seed).weight_init("SIZE")
+        .list(2)
+        .override(1, layer_type="OUTPUT", n_in=hidden, n_out=10,
+                  activation_function="softmax", loss_function="MCXENT")
+        .pretrain(False).backward(True)
+        .build()
+    )
+
+
+def digits_conv(lr: float = 0.05, num_iterations: int = 1, seed: int = 42
+                ) -> MultiLayerConfiguration:
+    """Small conv net for 8x8 digits: conv3x16 → pool2 → dense64 → softmax10.
+
+    Exercises the same conv→pool→dense path as LeNet (ref:
+    nn/layers/convolution/ConvolutionLayer.java:115-128) on real data."""
+    return (
+        NeuralNetConfiguration.Builder()
+        .lr(lr).momentum(0.9).use_ada_grad(False)
+        .num_iterations(num_iterations).seed(seed)
+        .weight_init("SIZE").activation_function("relu")
+        .list(4)
+        .override(0, layer_type="CONVOLUTION", n_in=1, n_out=16, filter_size=(3, 3))
+        .override(1, layer_type="SUBSAMPLING", stride=(2, 2))
+        .override(2, layer_type="DENSE", n_in=16 * 3 * 3, n_out=64)
+        .override(3, layer_type="OUTPUT", n_in=64, n_out=10,
+                  activation_function="softmax", loss_function="MCXENT")
+        .input_preprocessor(0, "ff_to_conv")
+        .input_preprocessor(2, "conv_to_ff")
+        .pretrain(False).backward(True)
+        .build()
+    )
+
+
 def stacked_denoising_autoencoder(
     n_in: int = 784, hidden=(500, 250), n_out: int = 10,
     corruption_level: float = 0.3, lr: float = 0.1,

@@ -111,11 +111,17 @@ class Solver:
                 return self._score(params, key), g(params, key)
 
             self._value_and_grad = grad_fn_custom
-        self._terminations = [EpsTermination(), ZeroDirection(),
-                              Norm2Termination()]
+        # Norm2Termination (grad_norm < eps) subsumes ZeroDirection
+        # (grad_norm == 0); ZeroDirection stays available for explicit use.
+        self._terminations = [EpsTermination(), Norm2Termination()]
         # how line-search solvers apply (direction, step) to x
         # (ref: optimize/stepfunctions/, selected by conf.step_function)
         self._step_fn = step_function(conf.step_function)
+        # gradient/negative_gradient apply the raw direction — the Armijo
+        # search would be computed and discarded, so skip it entirely
+        # (ref: GradientStepFunction ignores the step size)
+        self._uses_line_search = str(conf.step_function).lower() in (
+            "default", "negative_default")
         self.score_history: List[float] = []
 
     # ---- public API (ref: Solver.optimize) ----
@@ -211,12 +217,15 @@ class Solver:
                 d = -g + beta * d
                 if float(jnp.vdot(d, g)) >= 0:  # not a descent direction → restart
                     d = -g
-            step = ls(x, jnp.asarray(score), g, d, sub)
-            if float(step) == 0.0:
-                d = -g
+            if self._uses_line_search:
                 step = ls(x, jnp.asarray(score), g, d, sub)
                 if float(step) == 0.0:
-                    break
+                    d = -g
+                    step = ls(x, jnp.asarray(score), g, d, sub)
+                    if float(step) == 0.0:
+                        break
+            else:
+                step = jnp.float32(1.0)  # ignored by gradient step functions
             x = self._step_fn(x, d, step)
             g_prev = g
             old_score = score
@@ -358,12 +367,15 @@ class Solver:
                 b = rho_i * float(jnp.vdot(y, q))
                 q = q + (a - b) * s
             d = -q
-            step = ls(x, jnp.asarray(score), g, d, sub)
-            if float(step) == 0.0:
-                d = -g
+            if self._uses_line_search:
                 step = ls(x, jnp.asarray(score), g, d, sub)
                 if float(step) == 0.0:
-                    break
+                    d = -g
+                    step = ls(x, jnp.asarray(score), g, d, sub)
+                    if float(step) == 0.0:
+                        break
+            else:
+                step = jnp.float32(1.0)  # ignored by gradient step functions
             x_prev, g_prev = x, g
             x = self._step_fn(x, d, step)
             old_score = score

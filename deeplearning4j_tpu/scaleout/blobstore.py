@@ -153,10 +153,12 @@ class GCSBlobStore(BlobStore):
         self._bucket.blob(self._name(key)).delete()
 
     def list(self, prefix: str = "") -> List[str]:  # pragma: no cover
-        full = self._name(prefix) if prefix else self.prefix
-        names = [b.name for b in self._bucket.list_blobs(prefix=full)]
-        cut = len(self.prefix) + 1 if self.prefix else 0
-        return sorted(n[cut:] for n in names)
+        # anchor on "<store-prefix>/" so a sibling object sharing the prefix
+        # string (e.g. "models-old/x" next to store prefix "models") is
+        # neither matched nor mis-sliced
+        base = self.prefix + "/" if self.prefix else ""
+        names = [b.name for b in self._bucket.list_blobs(prefix=base + prefix)]
+        return sorted(n[len(base):] for n in names if n.startswith(base))
 
 
 def open_store(uri: str) -> BlobStore:

@@ -13,8 +13,10 @@ vector (the reference ships per-word vector slices in Word2VecWork jobs —
 a host-serialization concern XLA removes), train each job's pair batch with
 the SAME jitted batched steps the local models use (_sgns_step /
 _glove_step), and the standard ParameterAveragingAggregator averages worker
-vectors per IterativeReduce round. The shared lr decay counter keeps the
-reference's NUM_WORDS_SO_FAR semantics. On real silicon prefer the in-graph
+vectors per IterativeReduce round. The shared lr-decay counter follows the
+reference's NUM_WORDS_SO_FAR pattern but counts skip-gram PAIRS (the unit
+jobs are denominated in here); pass ``total_pairs`` accordingly
+(approx. 2 x window x corpus words). On real silicon prefer the in-graph
 mesh path (models/word2vec.py make_sharded_sgns_step); this is the
 control-plane-parity path.
 """
@@ -31,7 +33,7 @@ from deeplearning4j_tpu.scaleout.job import Job, JobIterator
 from deeplearning4j_tpu.scaleout.perform import WorkerPerformer
 from deeplearning4j_tpu.text.vocab import VocabCache
 
-NUM_WORDS_SO_FAR = "num_words_so_far"  # ref: Word2VecPerformer counter name
+NUM_PAIRS_SO_FAR = "num_pairs_so_far"  # ref pattern: Word2VecPerformer NUM_WORDS_SO_FAR (pair-denominated here)
 
 
 class Word2VecWorkPerformer(WorkerPerformer):
@@ -44,7 +46,7 @@ class Word2VecWorkPerformer(WorkerPerformer):
 
     def __init__(self, vocab: VocabCache, layer_size: int = 50,
                  negative: int = 5, lr: float = 0.025, min_lr: float = 1e-4,
-                 total_words: Optional[int] = None, tracker=None,
+                 total_pairs: Optional[int] = None, tracker=None,
                  seed: int = 123):
         from deeplearning4j_tpu.models.embeddings import InMemoryLookupTable
         from deeplearning4j_tpu.models.word2vec import _sgns_step
@@ -54,7 +56,7 @@ class Word2VecWorkPerformer(WorkerPerformer):
         self.negative = negative
         self.lr = lr
         self.min_lr = min_lr
-        self.total_words = total_words
+        self.total_pairs = total_pairs
         self.tracker = tracker
         self._step = _sgns_step
         table = InMemoryLookupTable(vocab, layer_size, seed=seed,
@@ -63,20 +65,21 @@ class Word2VecWorkPerformer(WorkerPerformer):
         self._syn1neg = jnp.asarray(table.syn1neg)
         self._probs_logits = jnp.log(jnp.asarray(table.unigram_probs()) + 1e-12)
         self._key = jax.random.PRNGKey(seed)
-        self._words_local = 0
+        self._pairs_local = 0
 
     @property
     def vocab_size(self) -> int:
         return self.vocab.num_words()
 
     def _current_lr(self) -> float:
-        """Linear decay by GLOBAL words seen — shared across workers via the
-        tracker counter (ref: Word2VecPerformer NUM_WORDS_SO_FAR)."""
-        if self.total_words is None:
+        """Linear decay by GLOBAL pairs seen — shared across workers via the
+        tracker counter (ref pattern: Word2VecPerformer NUM_WORDS_SO_FAR;
+        pair-denominated, matching the unit jobs carry)."""
+        if self.total_pairs is None:
             return self.lr
-        seen = (self.tracker.count(NUM_WORDS_SO_FAR)
-                if self.tracker is not None else self._words_local)
-        frac = min(float(seen) / max(self.total_words, 1), 1.0)
+        seen = (self.tracker.count(NUM_PAIRS_SO_FAR)
+                if self.tracker is not None else self._pairs_local)
+        frac = min(float(seen) / max(self.total_pairs, 1), 1.0)
         return max(self.min_lr, self.lr * (1.0 - frac))
 
     def perform(self, job: Job) -> None:
@@ -93,9 +96,9 @@ class Word2VecWorkPerformer(WorkerPerformer):
             self._probs_logits, jnp.float32(lr), sub, negative=self.negative,
         )
         n = int(centers.shape[0])
-        self._words_local += n
+        self._pairs_local += n
         if self.tracker is not None:
-            self.tracker.increment(NUM_WORDS_SO_FAR, n)
+            self.tracker.increment(NUM_PAIRS_SO_FAR, n)
         job.result = np.concatenate([
             np.asarray(self._syn0).ravel(),
             np.asarray(self._syn1neg).ravel(),

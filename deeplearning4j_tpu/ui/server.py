@@ -11,9 +11,18 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from deeplearning4j_tpu.ui import views
+
 INDEX_HTML = """<!doctype html>
 <html><head><title>deeplearning4j-tpu ui</title></head><body>
 <h1>deeplearning4j-tpu</h1>
+<h2>views</h2>
+<ul>
+<li><a href="/render/tsne">t-SNE scatter</a></li>
+<li><a href="/render/weights">weight histograms</a></li>
+<li><a href="/render/words">nearest-neighbour explorer</a></li>
+</ul>
+<h2>api</h2>
 <ul>
 <li><a href="/api/words">word vectors (count)</a></li>
 <li><a href="/api/nearest?word=WORD&n=5">nearest neighbours</a></li>
@@ -97,6 +106,8 @@ class UiServer:
                 q = parse_qs(url.query)
                 if url.path in ("/", "/index.html"):
                     self._send(200, INDEX_HTML.encode(), "text/html")
+                elif url.path in views.PAGES:
+                    self._send(200, views.PAGES[url.path].encode(), "text/html")
                 elif url.path == "/api/words":
                     self._json({"count": len(ui._words), "words": ui._words[:200]})
                 elif url.path == "/api/nearest":

@@ -1,0 +1,136 @@
+"""Browser render views for the UI server.
+
+The reference ships a d3/React webapp rendering t-SNE scatters, weight
+histograms and nearest-neighbour queries (ref: ui/UiServer.java +
+deeplearning4j-ui/src/main/resources/assets/). The TPU build serves the same
+views as self-contained HTML pages with inline JS — no build step, no
+external assets (zero-egress friendly): each page fetches the corresponding
+/api/* JSON endpoint and renders SVG client-side.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+# Corpus-derived strings (tokens, labels) are untrusted: escape before any
+# innerHTML/SVG interpolation (stored-XSS guard; injected into every page).
+_ESC_JS = """
+const esc = s => String(s).replace(/[&<>"']/g, c => ({
+  '&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
+"""
+
+_STYLE = """
+body{font-family:system-ui,sans-serif;margin:24px;color:#1a1a2e}
+h1{font-size:20px} .muted{color:#777;font-size:13px}
+svg{background:#fafafa;border:1px solid #ddd;border-radius:4px}
+table{border-collapse:collapse} td,th{padding:4px 10px;border:1px solid #ccc}
+input,button{font-size:14px;padding:4px 8px}
+.bar{fill:#4c72b0} .bar:hover{fill:#dd8452}
+text{font-size:10px;fill:#333}
+"""
+
+TSNE_HTML = """<!doctype html>
+<html><head><title>t-SNE</title><style>__STYLE__</style></head><body>
+<h1>t-SNE embedding</h1>
+<p class="muted">rendered from <a href="/api/tsne">/api/tsne</a></p>
+<div id="plot">loading…</div>
+<script>__ESC__
+fetch('/api/tsne').then(r => r.json()).then(d => {
+  const el = document.getElementById('plot');
+  if (!d.coords || !d.coords.length) { el.textContent = 'no t-SNE uploaded'; return; }
+  const W = 760, H = 560, PAD = 30;
+  const xs = d.coords.map(c => c[0]), ys = d.coords.map(c => c[1]);
+  const xmin = Math.min(...xs), xmax = Math.max(...xs);
+  const ymin = Math.min(...ys), ymax = Math.max(...ys);
+  const sx = v => PAD + (v - xmin) / (xmax - xmin || 1) * (W - 2 * PAD);
+  const sy = v => H - PAD - (v - ymin) / (ymax - ymin || 1) * (H - 2 * PAD);
+  const hue = s => { let h = 0; for (const ch of String(s)) h = (h * 31 + ch.charCodeAt(0)) % 360; return h; };
+  let svg = `<svg width="${W}" height="${H}">`;
+  d.coords.forEach((c, i) => {
+    const label = d.labels[i] ?? '';
+    svg += `<circle cx="${sx(c[0])}" cy="${sy(c[1])}" r="3.5"
+      fill="hsl(${hue(label)},65%,45%)"><title>${esc(label)}</title></circle>`;
+    if (d.coords.length <= 300)
+      svg += `<text x="${sx(c[0]) + 5}" y="${sy(c[1]) + 3}">${esc(label)}</text>`;
+  });
+  el.innerHTML = svg + '</svg>';
+});
+</script></body></html>""".replace("__STYLE__", _STYLE).replace("__ESC__", _ESC_JS)
+
+WEIGHTS_HTML = """<!doctype html>
+<html><head><title>weight histograms</title><style>__STYLE__</style></head><body>
+<h1>Weight histograms</h1>
+<p class="muted">rendered from <a href="/api/weights">/api/weights</a></p>
+<div id="plots">loading…</div>
+<script>__ESC__
+fetch('/api/weights').then(r => r.json()).then(d => {
+  const el = document.getElementById('plots');
+  const names = Object.keys(d);
+  if (!names.length) { el.textContent = 'no histograms uploaded'; return; }
+  el.innerHTML = '';
+  for (const name of names) {
+    const h = d[name];
+    if (!h.counts) continue;
+    const W = 420, H = 180, PAD = 24;
+    const maxc = Math.max(...h.counts, 1);
+    const bw = (W - 2 * PAD) / h.counts.length;
+    let svg = `<h3>${esc(name)}</h3><svg width="${W}" height="${H}">`;
+    h.counts.forEach((c, i) => {
+      const bh = c / maxc * (H - 2 * PAD);
+      const lo = h.edges ? h.edges[i].toPrecision(3) : i;
+      const hi = h.edges ? h.edges[i + 1].toPrecision(3) : i + 1;
+      svg += `<rect class="bar" x="${PAD + i * bw}" y="${H - PAD - bh}"
+        width="${Math.max(bw - 1, 1)}" height="${bh}">
+        <title>[${lo}, ${hi}): ${c}</title></rect>`;
+    });
+    if (h.edges) svg += `<text x="${PAD}" y="${H - 6}">${h.edges[0].toPrecision(3)}</text>
+      <text x="${W - PAD - 30}" y="${H - 6}">${h.edges[h.edges.length - 1].toPrecision(3)}</text>`;
+    el.innerHTML += svg + '</svg>';
+  }
+});
+</script></body></html>""".replace("__STYLE__", _STYLE).replace("__ESC__", _ESC_JS)
+
+WORDS_HTML = """<!doctype html>
+<html><head><title>nearest words</title><style>__STYLE__</style></head><body>
+<h1>Nearest-neighbour explorer</h1>
+<p class="muted">queries <a href="/api/nearest?word=&n=10">/api/nearest</a>
+over the uploaded word vectors (VPTree cosine search)</p>
+<input id="w" placeholder="word"> <button onclick="go()">search</button>
+<div id="out"></div>
+<script>__ESC__
+function go() {
+  const w = document.getElementById('w').value;
+  fetch('/api/nearest?word=' + encodeURIComponent(w) + '&n=10')
+    .then(r => r.json()).then(d => {
+      const rows = (d.neighbours || []).map(n =>
+        `<tr><td>${esc(n.word)}</td><td>${n.distance.toFixed(4)}</td></tr>`).join('');
+      document.getElementById('out').innerHTML = rows
+        ? `<table><tr><th>word</th><th>cosine distance</th></tr>${rows}</table>`
+        : 'no neighbours (word not in vocab?)';
+    });
+}
+document.getElementById('w').addEventListener('keydown',
+  e => { if (e.key === 'Enter') go(); });
+</script></body></html>""".replace("__STYLE__", _STYLE).replace("__ESC__", _ESC_JS)
+
+PAGES = {
+    "/render/tsne": TSNE_HTML,
+    "/render/weights": WEIGHTS_HTML,
+    "/render/words": WORDS_HTML,
+}
+
+
+def weight_histograms(net, bins: int = 40) -> Dict[str, Dict]:
+    """Per-parameter histograms from a MultiLayerNetwork, in the shape the
+    /render/weights view expects: {layerN/key: {counts, edges}}."""
+    out: Dict[str, Dict] = {}
+    for i, layer in enumerate(net.params_tree):
+        for key, arr in layer.items():
+            counts, edges = np.histogram(np.asarray(arr).ravel(), bins=bins)
+            out[f"layer{i}/{key}"] = {
+                "counts": counts.tolist(),
+                "edges": [float(e) for e in edges],
+            }
+    return out

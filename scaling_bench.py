@@ -1,0 +1,115 @@
+"""Scaling-efficiency benchmark (BASELINE config #5 analogue).
+
+Measures the synchronous data-parallel training step (in-graph gradient
+AllReduce — the XLA-native rewrite of the reference's per-iteration
+ParameterAveraging loop, ref: spark/impl/multilayer/SparkDl4jMultiLayer.java:183-203)
+at 1/2/4/8 virtual CPU devices, fixed per-device batch (weak scaling).
+
+Virtual CPU "devices" share one socket's cores, so wall-clock does NOT scale
+the way chips over ICI do (n=1 gets every core to itself; n=8 contend).
+The honest metric on this host is **DP overhead**: the sharded step at n
+devices vs the SAME global batch on a single device — identical total FLOPs
+on identical silicon, so any gap is sharding + collective overhead. Ideal is
+1.0; on real chips over ICI the same code's overhead is one gradient-pytree
+AllReduce per step (see parallel/trainer.py). This is the reference's own
+test posture (Spark local[8] — also one socket).
+
+Run:  python scaling_bench.py  →  prints JSON and writes SCALING_r02.json
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+PER_DEVICE_BATCH = 256
+STEPS = 30
+WARMUP = 5
+
+_CHILD = r"""
+import sys, time, json
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", int(sys.argv[1]))
+import jax.numpy as jnp
+sys.path.insert(0, {repo!r})
+
+from deeplearning4j_tpu.models.zoo import mnist_mlp
+from deeplearning4j_tpu.nn import functional as F
+from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
+from deeplearning4j_tpu.parallel.trainer import make_sync_train_step
+
+n = int(sys.argv[1])
+batch = int(sys.argv[2])
+conf = mnist_mlp(256, 128)
+params = F.init_params(conf, jax.random.PRNGKey(0))
+states = F.init_train_state(conf, params)
+mesh = data_parallel_mesh(n)
+step = make_sync_train_step(conf, mesh)
+
+key = jax.random.PRNGKey(1)
+x = jax.random.uniform(key, (batch, 784), jnp.float32)
+y = jax.nn.one_hot(jax.random.randint(key, (batch,), 0, 10), 10, dtype=jnp.float32)
+w = jnp.ones((batch,), jnp.float32)
+
+for i in range({warmup}):
+    params, states, score = step(params, states, jnp.asarray(i), x, y, w, key)
+jax.block_until_ready(params)
+t0 = time.perf_counter()
+for i in range({steps}):
+    params, states, score = step(params, states, jnp.asarray(i), x, y, w, key)
+jax.block_until_ready(params)
+dt = time.perf_counter() - t0
+assert bool(jnp.isfinite(score)), "non-finite score"
+print("MS", dt / {steps} * 1000.0)
+"""
+
+
+def measure(n_devices: int, global_batch: int) -> float:
+    """Per-step milliseconds at n virtual CPU devices (fresh subprocess — the
+    device count is fixed at backend init)."""
+    code = _CHILD.format(repo=os.path.dirname(os.path.abspath(__file__)),
+                         warmup=WARMUP, steps=STEPS)
+    out = subprocess.run(
+        [sys.executable, "-c", code, str(n_devices), str(global_batch)],
+        capture_output=True, text=True, timeout=600)
+    for line in out.stdout.splitlines():
+        if line.startswith("MS "):
+            return float(line.split()[1])
+    raise RuntimeError(f"scaling child failed (n={n_devices}):\n{out.stderr[-2000:]}")
+
+
+def main() -> None:
+    rows = []
+    for n in (1, 2, 4, 8):
+        gb = PER_DEVICE_BATCH * n
+        dp_ms = measure(n, gb)
+        single_ms = dp_ms if n == 1 else measure(1, gb)
+        rows.append({
+            "devices": n,
+            "per_device_batch": PER_DEVICE_BATCH,
+            "global_batch": gb,
+            "dp_step_ms": round(dp_ms, 2),
+            "single_device_same_batch_ms": round(single_ms, 2),
+            "dp_overhead_efficiency": round(single_ms / dp_ms, 3),
+            "global_samples_per_sec": round(gb / (dp_ms / 1000.0), 1),
+        })
+    out = {
+        "protocol": "sync DP (in-graph gradient AllReduce), MLP "
+                    "784-256-128-10 fp32, virtual CPU mesh. "
+                    "dp_overhead_efficiency = same-global-batch single-device "
+                    "step time / sharded step time (cores are shared across "
+                    "virtual devices, so this isolates sharding+collective "
+                    "overhead; ideal 1.0). Ref posture: Spark local[8], "
+                    "SparkDl4jMultiLayer.java:183-203",
+        "scaling": rows,
+    }
+    with open("SCALING_r02.json", "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""Real-data accuracy gates (fast pytest versions of accuracy_gates.py).
+
+The BASELINE north star is "train to reference accuracy". These gates run on
+REAL data available offline: Fisher's Iris (embedded) and sklearn's bundled
+UCI digits scans. The full protocol (more epochs + SdA wall-clock + labeled
+synthetic-MNIST convergence proofs) lives in accuracy_gates.py and records
+ACCURACY_r02.json.
+"""
+
+import pytest
+
+pytest.importorskip("sklearn")
+
+import accuracy_gates as ag
+
+
+def test_digits_mlp_real_data_gate():
+    r = ag.gate_digits_mlp(epochs=20, threshold=0.95)
+    assert r["provenance"] == "real"
+    assert r["passed"], f"digits MLP test accuracy {r['test_accuracy']} < 0.95"
+
+
+def test_digits_conv_real_data_gate():
+    r = ag.gate_digits_conv(epochs=15, threshold=0.93)
+    assert r["provenance"] == "real"
+    assert r["passed"], f"digits conv test accuracy {r['test_accuracy']} < 0.93"
+
+
+def test_iris_real_data_gate():
+    r = ag.gate_iris(epochs=150, threshold=0.9)
+    assert r["provenance"] == "real"
+    assert r["passed"], f"iris test accuracy {r['test_accuracy']} < 0.9"

@@ -6,7 +6,7 @@ import numpy as np
 
 from deeplearning4j_tpu.models.word2vec import Word2Vec
 from deeplearning4j_tpu.scaleout.nlp_perform import (
-    NUM_WORDS_SO_FAR,
+    NUM_PAIRS_SO_FAR,
     CoOccurrenceJobIterator,
     GloveWorkPerformer,
     SkipGramJobIterator,
@@ -56,7 +56,7 @@ class TestDistributedWord2Vec:
         runner = LocalDistributedRunner(
             performer_factory=lambda: Word2VecWorkPerformer(
                 vocab, layer_size=16, negative=5, lr=0.1,
-                total_words=len(centers), tracker=tracker, seed=1,
+                total_pairs=len(centers), tracker=tracker, seed=1,
             ),
             job_iterator=SkipGramJobIterator(centers, contexts, 2048),
             num_workers=4,
@@ -74,7 +74,7 @@ class TestDistributedWord2Vec:
         cross = _cosine(vec("apple"), vec("gpu"))
         assert same > cross, (same, cross)
         # the shared lr-decay counter advanced across workers
-        assert tracker.count(NUM_WORDS_SO_FAR) == len(centers)
+        assert tracker.count(NUM_PAIRS_SO_FAR) == len(centers)
 
 
 class TestDistributedGlove:

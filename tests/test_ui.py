@@ -77,3 +77,35 @@ def test_unknown_route_404(server):
     with pytest.raises(urllib.error.HTTPError) as e:
         _get(server, "/nope")
     assert e.value.code == 404
+
+
+def test_render_views_serve_html(server):
+    """The render pages (ref: deeplearning4j-ui webapp assets) are served as
+    self-contained HTML that fetches the matching /api endpoint."""
+    for path, marker in [("/render/tsne", b"/api/tsne"),
+                         ("/render/weights", b"/api/weights"),
+                         ("/render/words", b"/api/nearest")]:
+        status, body = _get(server, path)
+        assert status == 200
+        assert body.startswith(b"<!doctype html>")
+        assert marker in body and b"<script>" in body
+
+
+def test_weight_histograms_helper():
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.ui.views import weight_histograms
+
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .n_in(4).n_out(3).activation_function("tanh").list(1)
+        .override(0, layer_type="OUTPUT", activation_function="softmax",
+                  loss_function="MCXENT")
+        .pretrain(False).backward(True).build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    hists = weight_histograms(net, bins=10)
+    assert "layer0/W" in hists and "layer0/b" in hists
+    h = hists["layer0/W"]
+    assert len(h["counts"]) == 10 and len(h["edges"]) == 11
+    assert sum(h["counts"]) == 4 * 3
