@@ -155,6 +155,19 @@ class Solver:
     def _should_stop(self, score: float, old_score: float, grad_norm: float) -> bool:
         return any(t.terminate(score, old_score, grad_norm) for t in self._terminations)
 
+    def _search_step(self, ls, x, score, g, d, sub):
+        """(step, d, stop): Armijo step along d, retrying along -g, honoring
+        step functions that ignore the step size. Shared by CG and L-BFGS."""
+        if not self._uses_line_search:
+            return jnp.float32(1.0), d, False  # step ignored by gradient step fns
+        step = ls(x, jnp.asarray(score), g, d, sub)
+        if float(step) == 0.0:
+            d = -g
+            step = ls(x, jnp.asarray(score), g, d, sub)
+            if float(step) == 0.0:
+                return step, d, True
+        return step, d, False
+
     def _make_line_search(self, template):
         """Jitted Armijo search over the flat param vector; the key is an
         argument so stochastic objectives stay consistent within one search."""
@@ -217,15 +230,9 @@ class Solver:
                 d = -g + beta * d
                 if float(jnp.vdot(d, g)) >= 0:  # not a descent direction → restart
                     d = -g
-            if self._uses_line_search:
-                step = ls(x, jnp.asarray(score), g, d, sub)
-                if float(step) == 0.0:
-                    d = -g
-                    step = ls(x, jnp.asarray(score), g, d, sub)
-                    if float(step) == 0.0:
-                        break
-            else:
-                step = jnp.float32(1.0)  # ignored by gradient step functions
+            step, d, stop = self._search_step(ls, x, score, g, d, sub)
+            if stop:
+                break
             x = self._step_fn(x, d, step)
             g_prev = g
             old_score = score
@@ -367,15 +374,9 @@ class Solver:
                 b = rho_i * float(jnp.vdot(y, q))
                 q = q + (a - b) * s
             d = -q
-            if self._uses_line_search:
-                step = ls(x, jnp.asarray(score), g, d, sub)
-                if float(step) == 0.0:
-                    d = -g
-                    step = ls(x, jnp.asarray(score), g, d, sub)
-                    if float(step) == 0.0:
-                        break
-            else:
-                step = jnp.float32(1.0)  # ignored by gradient step functions
+            step, d, stop = self._search_step(ls, x, score, g, d, sub)
+            if stop:
+                break
             x_prev, g_prev = x, g
             x = self._step_fn(x, d, step)
             old_score = score

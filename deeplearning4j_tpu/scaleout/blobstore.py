@@ -153,6 +153,8 @@ class GCSBlobStore(BlobStore):
         self._bucket.blob(self._name(key)).delete()
 
     def list(self, prefix: str = "") -> List[str]:  # pragma: no cover
+        if prefix:
+            _check_key(prefix)
         # anchor on "<store-prefix>/" so a sibling object sharing the prefix
         # string (e.g. "models-old/x" next to store prefix "models") is
         # neither matched nor mis-sliced

@@ -122,15 +122,15 @@ PAGES = {
 }
 
 
-def weight_histograms(net, bins: int = 40) -> Dict[str, Dict]:
+def weight_histograms(net, bins: int = 50) -> Dict[str, Dict]:
     """Per-parameter histograms from a MultiLayerNetwork, in the shape the
-    /render/weights view expects: {layerN/key: {counts, edges}}."""
+    /render/weights view expects: {layerN/key: {counts, edges, ...}}.
+    Payload built by plot/renderers._histogram — one histogram contract for
+    both the artifact and UI paths."""
+    from deeplearning4j_tpu.plot.renderers import _histogram
+
     out: Dict[str, Dict] = {}
     for i, layer in enumerate(net.params_tree):
         for key, arr in layer.items():
-            counts, edges = np.histogram(np.asarray(arr).ravel(), bins=bins)
-            out[f"layer{i}/{key}"] = {
-                "counts": counts.tolist(),
-                "edges": [float(e) for e in edges],
-            }
+            out[f"layer{i}/{key}"] = _histogram(np.asarray(arr), bins=bins)
     return out

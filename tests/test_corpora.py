@@ -1,0 +1,186 @@
+"""Corpora pipeline tests (ref: text/corpora/treeparser/* + annotator/PoStagger
++ sentiwordnet/SWN3). End goal: RNTN trains on trees built from RAW TEXT."""
+
+import pytest
+
+from deeplearning4j_tpu.text.corpora import (
+    SWN3,
+    ConstituencyTree,
+    HeadWordFinder,
+    PennTreeReader,
+    PosTagger,
+    TreeIterator,
+    TreeParser,
+    TreeVectorizer,
+    binarize,
+    collapse_unaries,
+    to_rntn_tree,
+)
+
+
+class TestPennTreeReader:
+    def test_round_trip(self):
+        s = "(S (NP (DT the) (NN cat)) (VP (VBD sat) (PP (IN on) (NP (DT the) (NN mat)))))"
+        t = PennTreeReader.parse(s)
+        assert t.to_sexpr() == s
+        assert t.yield_words() == ["the", "cat", "sat", "on", "the", "mat"]
+
+    def test_multiple_trees_and_root_unwrap(self):
+        text = "(ROOT (S (NP (NN dogs)) (VP (VBP bark))))\n(S (NP (NN cats)) (VP (VBP meow)))"
+        trees = list(PennTreeReader(text))
+        assert len(trees) == 2
+        assert trees[0].tag == "S"  # ROOT unwrapped
+        assert trees[1].yield_words() == ["cats", "meow"]
+
+    def test_malformed_raises(self):
+        with pytest.raises((AssertionError, IndexError, ValueError)):
+            list(PennTreeReader("(S (NP"))
+
+
+class TestTransformers:
+    def test_collapse_unaries(self):
+        # X -> Y -> (leaves) collapses to X -> (leaves)
+        t = PennTreeReader.parse("(S (NP (NX (DT the) (NN cat))) (VP (VBD sat)))")
+        c = collapse_unaries(t)
+        assert c.children[0].tag == "NP"
+        assert [k.tag for k in c.children[0].children] == ["DT", "NN"]
+        # pre-terminal chains keep top tag
+        assert c.yield_words() == t.yield_words()
+
+    def test_binarize_left_factored(self):
+        t = PennTreeReader.parse("(NP (DT the) (JJ big) (JJ red) (NN dog))")
+        b = binarize(t)
+
+        def check(n):
+            assert len(n.children) in (0, 2)
+            for c in n.children:
+                check(c)
+
+        check(b)
+        assert b.yield_words() == ["the", "big", "red", "dog"]
+        assert b.tag == "NP"
+        # fabricated inner labels are marked
+        assert any(n
+                   for n in b.children if n.tag.startswith("@NP"))
+
+    def test_binarize_leaves_binary_tree_alone(self):
+        t = PennTreeReader.parse("(S (NP (NN x)) (VP (VBP y)))")
+        b = binarize(t)
+        assert b.to_sexpr() == t.to_sexpr()
+
+
+class TestHeadWordFinder:
+    def test_np_head_is_noun(self):
+        t = PennTreeReader.parse("(NP (DT the) (JJ big) (NN dog))")
+        head = HeadWordFinder().find_head(t)
+        assert head.word == "dog"
+
+    def test_s_head_through_vp(self):
+        t = PennTreeReader.parse(
+            "(S (NP (DT the) (NN cat)) (VP (VBD sat) (PP (IN on) (NP (NN mat)))))")
+        head = HeadWordFinder().find_head(t)
+        assert head.word == "sat"
+
+
+class TestPosTagger:
+    def test_basic_sentence(self):
+        tags = PosTagger().tag_sentence("the cat sat on the mat .")
+        assert tags == ["DT", "NN", "VBD", "IN", "DT", "NN", "."]
+
+    def test_suffix_and_shape_rules(self):
+        tagger = PosTagger()
+        tags = tagger.tag(["she", "quickly", "painted", "3", "beautiful", "houses"])
+        assert tags == ["PRP", "RB", "VBD", "CD", "JJ", "NNS"]
+
+    def test_capitalized_mid_sentence_is_nnp(self):
+        tags = PosTagger().tag(["then", "Alice", "spoke"])
+        assert tags[1] == "NNP"
+
+
+class TestSWN3:
+    def test_polarity_signs(self):
+        swn = SWN3()
+        assert swn.score("an excellent wonderful movie") > 0.5
+        assert swn.score("a terrible awful mess") < -0.5
+        assert swn.score("the chair is wooden") == 0.0
+
+    def test_negation_flips(self):
+        swn = SWN3()
+        assert swn.score("not good") < 0
+        assert swn.score("never boring") > 0
+
+    def test_buckets_partition(self):
+        swn = SWN3()
+        assert swn.class_for_score(0.9) == "strong_positive"
+        assert swn.class_for_score(0.4) == "positive"
+        assert swn.class_for_score(0.1) == "weak_positive"
+        assert swn.class_for_score(0.0) == "neutral"
+        assert swn.class_for_score(-0.1) == "weak_negative"
+        assert swn.class_for_score(-0.4) == "negative"
+        assert swn.class_for_score(-0.9) == "strong_negative"
+        assert swn.classify("an excellent superb masterpiece") == "strong_positive"
+
+    def test_sentiment_class_5way(self):
+        swn = SWN3()
+        assert swn.sentiment_class(-0.9) == 0
+        assert swn.sentiment_class(0.0) == 2
+        assert swn.sentiment_class(0.9) == 4
+
+
+class TestTreeParser:
+    def test_parse_structure(self):
+        t = TreeParser().get_trees("the cat sat on the mat .")[0]
+        assert t.tag == "S"
+        assert t.yield_words() == ["the", "cat", "sat", "on", "the", "mat", "."]
+        tags = {n.tag for n in _all_nodes(t)}
+        assert "NP" in tags and "VP" in tags  # real structure, not a chain
+
+    def test_sentence_splitting(self):
+        trees = TreeParser().get_trees("dogs bark . cats meow .")
+        assert len(trees) == 2
+        assert trees[1].yield_words() == ["cats", "meow", "."]
+
+
+def _all_nodes(t):
+    out = [t]
+    for c in t.children:
+        out.extend(_all_nodes(c))
+    return out
+
+
+class TestTreeVectorizer:
+    def test_labeled_binary_trees(self):
+        vec = TreeVectorizer()
+        trees = vec.get_trees_with_labels("this movie is an excellent masterpiece .")
+        assert len(trees) == 1
+        t = trees[0]
+        for n in t.preorder():
+            assert len(n.children) in (0, 2)
+            assert 0 <= n.label <= 4
+        assert t.label >= 3  # positive sentence at the root
+
+    def test_rntn_trains_from_raw_text(self):
+        """The full pipeline the reference builds from UIMA+treebank parts:
+        raw sentences → trees → RNTN.fit (ref: rntn/RNTN.java + TreeVectorizer)."""
+        from deeplearning4j_tpu.models.rntn import RNTN
+
+        sents = ("an excellent wonderful movie . a terrible awful mess . "
+                 "a brilliant amazing film . a boring dull disaster .")
+        trees = TreeVectorizer().get_trees_with_labels(sents)
+        assert len(trees) == 4
+        model = RNTN(num_hidden=8, iterations=8, lr=0.05, seed=3)
+        model.fit(trees)
+        assert model.losses[-1] < model.losses[0]
+
+    def test_tree_iterator_batches(self):
+        from deeplearning4j_tpu.text.sentence_iterator import (
+            CollectionSentenceIterator,
+        )
+
+        it = TreeIterator(
+            CollectionSentenceIterator(["good movie .", "bad movie .",
+                                        "great fun ."]),
+            TreeVectorizer(), batch_size=2)
+        batches = list(it)
+        assert sum(len(b) for b in batches) == 3
+        assert all(hasattr(t, "preorder") for b in batches for t in b)

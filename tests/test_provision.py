@@ -1,0 +1,108 @@
+"""Provisioning tests (ref: aws/ec2/provision/ — Ec2BoxCreator,
+HostProvisioner, ClusterSetup). Commands are asserted through a recording
+runner; nothing touches a real cloud."""
+
+from deeplearning4j_tpu.scaleout.provision import (
+    ClusterSetup,
+    HostProvisioner,
+    TpuPodCreator,
+    TpuPodSpec,
+)
+
+
+class RecordingRunner:
+    def __init__(self, code: int = 0, out: str = "ok"):
+        self.calls = []
+        self.code = code
+        self.out = out
+
+    def __call__(self, argv):
+        self.calls.append(list(argv))
+        return self.code, self.out
+
+
+class TestTpuPodCreator:
+    def test_create_command(self):
+        spec = TpuPodSpec(name="pod1", accelerator_type="v5litepod-8",
+                          zone="us-east5-b", project="proj",
+                          labels={"team": "ml", "env": "dev"})
+        cmd = TpuPodCreator(spec).create_command()
+        assert cmd[:5] == ["gcloud", "compute", "tpus", "tpu-vm", "create"]
+        assert "pod1" in cmd and "--zone=us-east5-b" in cmd
+        assert "--project=proj" in cmd
+        assert "--accelerator-type=v5litepod-8" in cmd
+        assert "--labels=env=dev,team=ml" in cmd  # sorted, deterministic
+
+    def test_lifecycle_through_runner(self):
+        rec = RecordingRunner()
+        creator = TpuPodCreator(TpuPodSpec(name="p"), runner=rec)
+        creator.create()
+        creator.destroy()
+        assert rec.calls[0][4] == "create"
+        assert rec.calls[1][4] == "delete" and "--quiet" in rec.calls[1]
+
+
+class TestHostProvisioner:
+    def test_run_remote_command(self):
+        rec = RecordingRunner()
+        HostProvisioner("pod", worker=3, runner=rec).run_remote_command("ls /tmp")
+        argv = rec.calls[0]
+        assert "ssh" in argv and "--worker=3" in argv
+        assert "--command=ls /tmp" in argv
+
+    def test_upload_and_run(self):
+        rec = RecordingRunner()
+        HostProvisioner("pod", runner=rec).upload_and_run("/local/setup.sh", "/opt")
+        assert "scp" in rec.calls[0] and "pod:/opt" in rec.calls[0]
+        assert any("bash setup.sh" in a for a in rec.calls[1])
+
+    def test_upload_failure_short_circuits(self):
+        rec = RecordingRunner(code=1, out="denied")
+        code, _ = HostProvisioner("pod", runner=rec).upload_and_run("s.sh")
+        assert code == 1 and len(rec.calls) == 1  # no remote run attempted
+
+
+class TestClusterSetup:
+    def test_exec_provisions_and_launches_every_host(self):
+        rec = RecordingRunner()
+        spec = TpuPodSpec(name="pod", num_hosts=4)
+        setup = ClusterSetup(spec, ["python", "train.py", "--conf", "c.json"],
+                             runner=rec)
+        results = setup.exec("/local/setup.sh", coordinator_host="10.0.0.2")
+        assert len(results) == 8  # 4 provision + 4 launches
+        launches = [c for c in rec.calls if any("DL4J_PROCESS_ID" in a for a in c)]
+        assert len(launches) == 4
+        cmd0 = next(a for a in launches[0] if "DL4J_PROCESS_ID" in a)
+        # multihost env wiring matches parallel/multihost.initialize()
+        assert "DL4J_COORDINATOR=10.0.0.2:8476" in cmd0
+        assert "DL4J_NUM_PROCESSES=4" in cmd0
+        assert "python train.py --conf c.json" in cmd0
+
+    def test_distinct_process_ids(self):
+        rec = RecordingRunner()
+        setup = ClusterSetup(TpuPodSpec(num_hosts=2), ["run"], runner=rec)
+        setup.exec("s.sh")
+        ids = set()
+        for call in rec.calls:
+            for a in call:
+                if "DL4J_PROCESS_ID=" in a:
+                    ids.add(a.split("DL4J_PROCESS_ID=")[1].split()[0])
+        assert ids == {"0", "1"}
+
+
+class TestReviewFixes:
+    def test_tilde_root_dir_not_quoted(self):
+        rec = RecordingRunner()
+        HostProvisioner("pod", runner=rec).upload_and_run("/local/s.sh")  # default ~
+        cmd = next(a for a in rec.calls[1] if a.startswith("--command="))
+        assert "cd ~ &&" in cmd and "'~'" not in cmd
+
+    def test_exec_aborts_when_provisioning_fails(self):
+        import pytest as _pytest
+
+        rec = RecordingRunner(code=1, out="boom")
+        setup = ClusterSetup(TpuPodSpec(num_hosts=2), ["run"], runner=rec)
+        with _pytest.raises(RuntimeError, match="provisioning failed"):
+            setup.exec("s.sh")
+        # no launch command was issued
+        assert not any("DL4J_PROCESS_ID" in a for c in rec.calls for a in c)

@@ -1,0 +1,123 @@
+"""StringGrid / FingerPrintKeyer / SloppyMath tests
+(ref: util/StringGrid.java, util/FingerPrintKeyer.java,
+berkeley/SloppyMath.java)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.utils.sloppy_math import (
+    is_dangerous,
+    is_discrete_prob,
+    lambert,
+    log_add,
+    log_add_all,
+    log_normalize,
+    relative_difference,
+)
+from deeplearning4j_tpu.utils.string_grid import FingerPrintKeyer, StringGrid
+
+
+class TestFingerPrintKeyer:
+    def test_normalizes_case_punct_order(self):
+        k = FingerPrintKeyer()
+        assert k.key("Hello, World!") == k.key("world hello")
+        assert k.key("  Acme Corp. ") == k.key("acme corp")
+
+    def test_accents_stripped(self):
+        k = FingerPrintKeyer()
+        assert k.key("café") == k.key("cafe")
+
+    def test_dedup_tokens(self):
+        assert FingerPrintKeyer().key("a a b") == "a b"
+
+
+class TestStringGrid:
+    def _grid(self):
+        return StringGrid(sep=",", data=[
+            "Acme Corp,NY,100",
+            "acme corp.,NY,200",
+            "Beta LLC,SF,300",
+        ])
+
+    def test_columns(self):
+        g = self._grid()
+        assert g.get_num_columns() == 3
+        assert g.get_column(1) == ["NY", "NY", "SF"]
+
+    def test_ragged_row_rejected(self):
+        g = self._grid()
+        with pytest.raises(ValueError):
+            g.append_line("only,two")
+
+    def test_dedupe_by_cluster(self):
+        g = self._grid()
+        g.dedupe_by_cluster(0)  # Acme Corp ≡ acme corp. by fingerprint
+        assert len(g) == 2
+        assert g[0][0] == "Acme Corp" and g[1][0] == "Beta LLC"
+
+    def test_cluster_column(self):
+        clusters = self._grid().cluster_column(0)
+        assert sorted(map(len, clusters.values())) == [1, 2]
+
+    def test_select_and_filter(self):
+        g = self._grid()
+        assert len(g.select(1, "NY")) == 2
+        assert g.filter_rows_by_column(1, {"SF"}) == [2]
+
+    def test_remove_columns_and_merge(self):
+        g = self._grid()
+        g.merge(0, 1)
+        assert g[0][0] == "Acme Corp NY" and g.get_num_columns() == 2
+
+    def test_split_column(self):
+        g = StringGrid(sep="|", data=["a b|x", "c|y"])
+        g.split(0, " ")
+        assert g[0] == ["a", "b", "x"] and g[1] == ["c", "", "y"]
+
+    def test_similarity_filter(self):
+        g = StringGrid(sep=",", data=["Acme Corp,acme corp", "Acme Corp,zebra"])
+        similar = g.get_all_with_similarity(0.9, 0, 1)
+        assert len(similar) == 1 and similar[0][1] == "acme corp"
+        g.filter_by_similarity(0.9, 0, 1)
+        assert len(g) == 1 and g[0][1] == "zebra"
+
+    def test_file_round_trip(self, tmp_path):
+        g = self._grid()
+        p = str(tmp_path / "g.csv")
+        g.write_lines_to(p)
+        g2 = StringGrid.from_file(p, sep=",")
+        assert list(g2) == list(g)
+
+
+class TestSloppyMath:
+    def test_log_add_matches_naive(self):
+        for lx, ly in [(-1.0, -2.0), (0.0, 0.0), (-700.0, -701.0), (5.0, -40.0)]:
+            assert log_add(lx, ly) == pytest.approx(
+                math.log(math.exp(lx) + math.exp(ly)), rel=1e-9)
+
+    def test_log_add_extremes(self):
+        assert log_add(float("-inf"), float("-inf")) == float("-inf")
+        assert log_add(-1000.0, 0.0) == 0.0  # tolerance early-out
+        # overflow-free where naive exp would blow up
+        assert log_add(800.0, 800.0) == pytest.approx(800.0 + math.log(2))
+
+    def test_log_add_all_and_normalize(self):
+        v = [-1.0, -2.0, -3.0]
+        assert log_add_all(v) == pytest.approx(
+            math.log(sum(math.exp(x) for x in v)))
+        assert np.exp(log_normalize(v)).sum() == pytest.approx(1.0)
+        assert log_add_all([]) == float("-inf")
+
+    def test_predicates(self):
+        assert is_dangerous(0.0) and is_dangerous(float("nan"))
+        assert not is_dangerous(1.0)
+        assert is_discrete_prob(1.0) and not is_discrete_prob(1.1)
+        assert relative_difference(1.0, 2.0) == pytest.approx(0.5)
+
+    def test_lambert(self):
+        # w e^w = v e^u
+        v, u = 1.0, 0.5
+        w = lambert(v, u)
+        assert w * math.exp(w) == pytest.approx(v * math.exp(u), rel=1e-9)
