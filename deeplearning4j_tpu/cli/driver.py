@@ -39,17 +39,40 @@ def _npz_path(path: str) -> str:
     return path if path.endswith(".npz") else path + ".npz"
 
 
+def _load_params(path: str) -> np.ndarray:
+    """--model accepts plain paths or blob-store URIs (ref: the CLI's URI
+    Scheme registry, cli/api/schemes/ — here file://, gs://, mem://)."""
+    if "://" in path:
+        import io
+
+        from deeplearning4j_tpu.scaleout.blobstore import open_store
+
+        uri, _, key = _npz_path(path).rpartition("/")
+        with np.load(io.BytesIO(open_store(uri).get(key))) as z:
+            return z["params"]
+    return np.load(_npz_path(path))["params"]
+
+
 def _load_model(conf_path: str, params_path: Optional[str]) -> MultiLayerNetwork:
     with open(conf_path, "r", encoding="utf-8") as f:
         conf = MultiLayerConfiguration.from_json(f.read())
     net = MultiLayerNetwork(conf).init()
     if params_path:
-        flat = np.load(_npz_path(params_path))["params"]
-        net.set_params(flat)
+        net.set_params(_load_params(params_path))
     return net
 
 
 def _save_model(net: MultiLayerNetwork, path: str) -> None:
+    if "://" in path:
+        import io
+
+        from deeplearning4j_tpu.scaleout.blobstore import open_store
+
+        uri, _, key = _npz_path(path).rpartition("/")
+        buf = io.BytesIO()
+        np.savez(buf, params=np.asarray(net.params()))
+        open_store(uri).put(key, buf.getvalue())
+        return
     np.savez(_npz_path(path), params=np.asarray(net.params()))
 
 

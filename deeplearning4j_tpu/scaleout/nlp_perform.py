@@ -212,3 +212,47 @@ class CoOccurrenceJobIterator(JobIterator):
 
     def reset(self) -> None:
         self._pos = 0
+
+
+class WordCountWorkPerformer(WorkerPerformer):
+    """Distributed word counting (ref: scaleout/perform/text/
+    WordCountWorkPerformer.java — each job is a chunk of sentences; the
+    result is a token→count Counter the aggregator merges into the vocab).
+    """
+
+    def __init__(self, tokenizer_factory=None):
+        from deeplearning4j_tpu.text.tokenization import DefaultTokenizerFactory
+
+        self.factory = tokenizer_factory or DefaultTokenizerFactory()
+
+    def perform(self, job: Job) -> None:
+        from deeplearning4j_tpu.utils.counter import Counter
+
+        counts = Counter()
+        sentences = job.work if isinstance(job.work, (list, tuple)) else [job.work]
+        for sentence in sentences:
+            for tok in self.factory.create(sentence).get_tokens():
+                counts.increment_count(tok, 1.0)
+        job.result = counts
+
+    def update(self, *args) -> None:  # stateless between jobs
+        pass
+
+
+class WordCountJobAggregator:
+    """Merges per-job Counters (ref: scaleout/perform/text/
+    WordCountJobAggregator — accumulate into one vocab count)."""
+
+    def __init__(self):
+        from deeplearning4j_tpu.utils.counter import Counter
+
+        self.counts = Counter()
+
+    def accumulate(self, job: Job) -> None:
+        if job.result is None:
+            return
+        for key in job.result.key_set():
+            self.counts.increment_count(key, job.result.get_count(key))
+
+    def aggregate(self):
+        return self.counts

@@ -1,0 +1,175 @@
+"""Auxiliary component parity tests: vectorizers + dataset persistence
+(ref: datasets/vectorizer/, datasets/creator/), document iterators
+(ref: text/documentiterator/), the plotting iteration listener
+(ref: plot/iterationlistener/), distributed word counting
+(ref: scaleout/perform/text/), and CLI blob-URI model IO
+(ref: cli/api/schemes/)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.vectorizer import (
+    DirectoryImageVectorizer,
+    ImageVectorizer,
+    load_dataset,
+    save_dataset,
+)
+
+
+def _write_pgm(path, value: int, side: int = 4):
+    img = np.full((side, side), value, np.uint8)
+    with open(path, "wb") as f:
+        f.write(b"P5\n%d %d\n255\n" % (side, side) + img.tobytes())
+
+
+class TestVectorizers:
+    def test_image_vectorizer_one_row(self, tmp_path):
+        p = str(tmp_path / "img.pgm")
+        _write_pgm(p, 128)
+        ds = ImageVectorizer(p, num_labels=3, label=1).vectorize()
+        assert ds.features.shape == (1, 16)
+        assert ds.labels.tolist() == [[0.0, 1.0, 0.0]]
+        assert ds.features[0, 0] == pytest.approx(128 / 255)
+
+    def test_image_vectorizer_resize(self, tmp_path):
+        p = str(tmp_path / "img.pgm")
+        _write_pgm(p, 10, side=8)
+        ds = ImageVectorizer(p, num_labels=2, label=0, width=4, height=4).vectorize()
+        assert ds.features.shape == (1, 16)
+
+    def test_directory_vectorizer(self, tmp_path):
+        for label in ("cat", "dog"):
+            os.makedirs(tmp_path / label)
+            for i in range(2):
+                _write_pgm(str(tmp_path / label / f"{i}.pgm"), 50 + i)
+        ds = DirectoryImageVectorizer(str(tmp_path)).vectorize()
+        assert ds.features.shape == (4, 16)
+        assert ds.labels.shape == (4, 2)
+        assert ds.labels.sum() == 4.0
+
+    def test_dataset_save_load_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        ds = DataSet(np.ones((3, 2), np.float32), np.eye(3, dtype=np.float32))
+        path = save_dataset(str(tmp_path / "mnist-ds"), ds)
+        assert path.endswith(".npz")
+        back = load_dataset(path)
+        np.testing.assert_array_equal(back.features, ds.features)
+        np.testing.assert_array_equal(back.labels, ds.labels)
+
+
+class TestDocumentIterator:
+    def test_file_documents(self, tmp_path):
+        from deeplearning4j_tpu.text.document_iterator import FileDocumentIterator
+
+        (tmp_path / "a.txt").write_text("first doc")
+        sub = tmp_path / "sub"
+        sub.mkdir()
+        (sub / "b.txt").write_text("second doc")
+        docs = list(FileDocumentIterator(str(tmp_path)))
+        assert docs == ["first doc", "second doc"]
+
+    def test_document_to_sentence_adapter(self):
+        from deeplearning4j_tpu.text.document_iterator import (
+            CollectionDocumentIterator,
+            DocumentSentenceIterator,
+        )
+
+        it = DocumentSentenceIterator(
+            CollectionDocumentIterator(["line one\nline two", "line three"]))
+        sents = []
+        while it.has_next():
+            sents.append(it.next_sentence())
+        assert sents == ["line one", "line two", "line three"]
+        it.reset()
+        assert it.has_next()
+
+
+class TestPlotterIterationListener:
+    def test_renders_on_frequency(self, tmp_path):
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.plot.iteration_listener import (
+            PlotterIterationListener,
+        )
+
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .n_in(4).n_out(3).activation_function("tanh").lr(0.1)
+            .num_iterations(7).list(1)
+            .override(0, layer_type="OUTPUT", activation_function="softmax",
+                      loss_function="MCXENT")
+            .pretrain(False).backward(True).build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        listener = PlotterIterationListener(frequency=3,
+                                            out_dir=str(tmp_path / "plots"))
+        net.set_listeners([listener])
+        x = np.random.default_rng(0).random((12, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[np.arange(12) % 3]
+        net.fit(x, labels=y)  # 7 iterations → renders at 3 and 6
+        assert len(listener.paths) == 2
+        for p in listener.paths:
+            assert os.path.exists(p + ".json") or os.path.exists(p)
+
+    def test_bad_frequency_rejected(self):
+        from deeplearning4j_tpu.plot.iteration_listener import (
+            PlotterIterationListener,
+        )
+
+        with pytest.raises(ValueError):
+            PlotterIterationListener(frequency=0)
+
+
+class TestWordCount:
+    def test_performer_and_aggregator(self):
+        from deeplearning4j_tpu.scaleout.job import Job
+        from deeplearning4j_tpu.scaleout.nlp_perform import (
+            WordCountJobAggregator,
+            WordCountWorkPerformer,
+        )
+
+        performer = WordCountWorkPerformer()
+        agg = WordCountJobAggregator()
+        for chunk in (["the cat sat", "the dog"], ["the end"]):
+            job = Job(chunk, "w0")
+            performer.perform(job)
+            agg.accumulate(job)
+        merged = agg.aggregate()
+        assert merged.get_count("the") == 3.0
+        assert merged.get_count("cat") == 1.0
+
+
+class TestCliBlobUri:
+    def test_model_round_trip_through_file_uri(self, tmp_path):
+        from deeplearning4j_tpu.cli.driver import main
+        from deeplearning4j_tpu.datasets.fetchers import iris_data
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+
+        conf = (
+            NeuralNetConfiguration.Builder()
+            .n_in(4).n_out(8).activation_function("tanh").lr(0.1)
+            .momentum(0.9).use_ada_grad(True).num_iterations(40).seed(42)
+            .weight_init("VI").list(2)
+            .override(0, layer_type="DENSE")
+            .override(1, layer_type="OUTPUT", n_in=8, n_out=3,
+                      activation_function="softmax", loss_function="MCXENT")
+            .pretrain(False).backward(True).build()
+        )
+        conf_path = tmp_path / "model.json"
+        conf_path.write_text(conf.to_json())
+        x, y = iris_data()
+        csv = tmp_path / "iris.csv"
+        csv.write_text("\n".join(
+            ",".join(f"{v:.4f}" for v in row) + f",{int(lab)}"
+            for row, lab in zip(x, y)) + "\n")
+
+        store_dir = tmp_path / "store"
+        uri = f"file://{store_dir}/params.npz"
+        assert main(["train", "--conf", str(conf_path), "--input", str(csv),
+                     "--model", uri, "--labels", "3", "--batch", "150"]) == 0
+        assert (store_dir / "params.npz").exists()
+        assert main(["test", "--conf", str(conf_path), "--input", str(csv),
+                     "--model", uri, "--labels", "3", "--batch", "150"]) == 0
