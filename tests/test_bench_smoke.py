@@ -1,0 +1,58 @@
+"""CI gate that `python bench.py` completes within its stage budgets on the
+CPU backend and always lands a parseable summary line — so a driver timeout
+like round 2's rc=124 can never recur silently (VERDICT r02 next-steps #1/#10).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_completes_on_cpu():
+    env = dict(os.environ)
+    # JAX_PLATFORMS env does not stick (sitecustomize pins the TPU);
+    # BENCH_FORCE_CPU makes every stage child flip jax.config to CPU
+    env["BENCH_FORCE_CPU"] = "1"
+    env["BENCH_FAST"] = "1"
+    env["BENCH_BUDGET_SEC"] = "240"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=300, cwd=REPO, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = out.stdout.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert rec["metric"] == "mnist_mlp_train_samples_per_sec_per_chip"
+    assert rec["value"] and rec["value"] > 0
+    det = rec["detail"]
+    # CPU baseline ran first and loudly: either a number or an explicit
+    # failed status — never a silent 0.0.
+    assert det.get("cpu_mlp_fp32_samples_per_sec") or \
+        "failed" in str(det.get("cpu_mlp_fp32_status", ""))
+    # MFU recorded for every completed TPU-model stage
+    for stage in ("mlp_bf16", "mlp_fp32", "lenet_bf16", "lenet_fp32"):
+        if det.get(f"{stage}_samples_per_sec"):
+            assert f"{stage}_mfu" in det
+    # the partial file was flushed incrementally
+    assert os.path.exists(os.path.join(REPO, "bench_partial.json"))
+
+
+def test_bench_skips_stages_past_deadline():
+    env = dict(os.environ)
+    env["BENCH_FORCE_CPU"] = "1"
+    env["BENCH_FAST"] = "1"
+    env["BENCH_BUDGET_SEC"] = "1"  # already expired: every stage must skip
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=120, cwd=REPO, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["value"] == 0.0 and rec["vs_baseline"] is None
+    assert all(
+        v == "skipped_budget"
+        for k, v in rec["detail"].items() if k.endswith("_status")
+    )
