@@ -38,11 +38,14 @@ def _one_hot(y: np.ndarray, k: int) -> np.ndarray:
     return out
 
 
-def _accuracy(net, x: np.ndarray, y: np.ndarray) -> float:
+def _accuracy(net, x: np.ndarray, y: np.ndarray, num_classes: int) -> float:
+    # num_classes is the KNOWN class count — inferring it from the test
+    # split's max label would shrink the one-hot matrix (and corrupt the
+    # Evaluation) whenever the split happens to lack the top class
     from deeplearning4j_tpu.eval import Evaluation
 
     ev = Evaluation()
-    ev.eval(_one_hot(y, int(y.max()) + 1), np.asarray(net.label_probabilities(x)))
+    ev.eval(_one_hot(y, num_classes), np.asarray(net.label_probabilities(x)))
     return ev.accuracy()
 
 
@@ -68,7 +71,7 @@ def gate_iris(epochs: int = 300, threshold: float = 0.93) -> dict:
     t0 = time.perf_counter()
     net.fit_epochs(xtr, num_epochs=epochs, labels=_one_hot(ytr, 3))
     wall = time.perf_counter() - t0
-    acc = _accuracy(net, xte, yte)
+    acc = _accuracy(net, xte, yte, 3)
     return {"gate": "iris_mlp", "dataset": "iris (real, Fisher 1936, embedded)",
             "provenance": "real", "test_accuracy": round(acc, 4),
             "threshold": threshold, "passed": acc >= threshold,
@@ -87,7 +90,7 @@ def _run_digits(conf_fn, name: str, epochs: int, threshold: float,
     net.fit_epochs(xtr, num_epochs=epochs, labels=_one_hot(ytr, 10),
                    batch_size=batch_size)
     wall = time.perf_counter() - t0
-    acc = _accuracy(net, xte, yte)
+    acc = _accuracy(net, xte, yte, 10)
     return {"gate": name,
             "dataset": "sklearn digits (real, UCI optdigits 8x8, 1797 scans)",
             "provenance": "real", "test_accuracy": round(acc, 4),
@@ -126,7 +129,7 @@ def gate_sda_digits(threshold: float = 0.90) -> dict:
     net.fit(xtr, labels=_one_hot(ytr, 10), batch_size=250)  # pretrain+finetune+bp
     net.fit_epochs(xtr, num_epochs=30, labels=_one_hot(ytr, 10), batch_size=128)
     wall = time.perf_counter() - t0
-    acc = _accuracy(net, xte, yte)
+    acc = _accuracy(net, xte, yte, 10)
     return {"gate": "sda_digits",
             "dataset": "sklearn digits (real, UCI optdigits 8x8, 1797 scans)",
             "provenance": "real", "test_accuracy": round(acc, 4),
@@ -146,7 +149,7 @@ def _run_synthetic_mnist(conf_fn, name: str, epochs: int, threshold: float,
     net.fit_epochs(xtr, num_epochs=epochs, labels=_one_hot(ytr, 10),
                    batch_size=256)
     wall = time.perf_counter() - t0
-    acc = _accuracy(net, xte, yte)
+    acc = _accuracy(net, xte, yte, 10)
     return {"gate": name, "dataset": "synthetic_mnist (SYNTHETIC surrogate)",
             "provenance": "synthetic",
             "note": "convergence proof only — NOT a real-data accuracy claim",

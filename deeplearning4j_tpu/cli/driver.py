@@ -45,9 +45,9 @@ def _load_params(path: str) -> np.ndarray:
     if "://" in path:
         import io
 
-        from deeplearning4j_tpu.scaleout.blobstore import open_store
+        from deeplearning4j_tpu.scaleout.blobstore import open_store, split_store_uri
 
-        uri, _, key = _npz_path(path).rpartition("/")
+        uri, key = split_store_uri(_npz_path(path))
         with np.load(io.BytesIO(open_store(uri).get(key))) as z:
             return z["params"]
     return np.load(_npz_path(path))["params"]
@@ -66,9 +66,9 @@ def _save_model(net: MultiLayerNetwork, path: str) -> None:
     if "://" in path:
         import io
 
-        from deeplearning4j_tpu.scaleout.blobstore import open_store
+        from deeplearning4j_tpu.scaleout.blobstore import open_store, split_store_uri
 
-        uri, _, key = _npz_path(path).rpartition("/")
+        uri, key = split_store_uri(_npz_path(path))
         buf = io.BytesIO()
         np.savez(buf, params=np.asarray(net.params()))
         open_store(uri).put(key, buf.getvalue())

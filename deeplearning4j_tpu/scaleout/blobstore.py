@@ -163,19 +163,43 @@ class GCSBlobStore(BlobStore):
         return sorted(n[len(base):] for n in names if n.startswith(base))
 
 
+# mem:// stores live for the life of the process, keyed by the URI's
+# authority/path — so `train --model mem://x/params` followed by
+# `test --model mem://x/params` in the same process reads the same bytes
+# (a fresh store per open_store call would silently drop every write)
+_MEM_STORES: Dict[str, InMemoryBlobStore] = {}
+
+
 def open_store(uri: str) -> BlobStore:
     """URI scheme → store (parity with the CLI's URI Scheme registry,
     ref: cli/api/schemes/): file:///dir, mem://, gs://bucket/prefix."""
     if uri.startswith("file://"):
         return LocalBlobStore(uri[len("file://"):])
     if uri.startswith("mem://"):
-        return InMemoryBlobStore()
+        name = uri[len("mem://"):].strip("/")
+        return _MEM_STORES.setdefault(name, InMemoryBlobStore())
     if uri.startswith("gs://"):
         rest = uri[len("gs://"):]
         bucket, _, prefix = rest.partition("/")
         return GCSBlobStore(bucket, prefix)
     # bare paths are local directories
     return LocalBlobStore(uri)
+
+
+def split_store_uri(path: str) -> tuple:
+    """Split ``<scheme>://<base>/<key>`` into (store URI, key) scheme-aware:
+    a key directly after the scheme (``mem://params.npz``) yields the
+    scheme's root store rather than misparsing into a literal local
+    directory named ``mem:`` (a naive rpartition('/') does exactly that)."""
+    scheme, sep, rest = path.partition("://")
+    if not sep:
+        base, _, key = path.rpartition("/")
+        return base, key
+    if "/" in rest:
+        base, _, key = rest.rpartition("/")
+    else:
+        base, key = "", rest
+    return f"{scheme}://{base}", key
 
 
 # --------------------------------------------------------------- adapters ----

@@ -116,9 +116,11 @@ class PosTagger:
             prev = tags[i - 1]
             if prev in ("DT", "JJ", "PRP$") and tags[i] in ("VB", "VBP", "VBG", "VBD"):
                 tags[i] = "NN"
-            # "to <verb-ish noun-guess>" keeps VB: "to run"
-            if prev == "TO" and tags[i] == "NN" and tokens[i].lower() in _LEXICON:
-                pass
+            # infinitival "to <unknown>" prefers the verb reading ("to walk"):
+            # NN here can only be the out-of-lexicon fallback guess, and after
+            # TO an unknown token is far more likely a verb
+            elif prev == "TO" and tags[i] == "NN":
+                tags[i] = "VB"
         return tags
 
     def tag_sentence(self, sentence: str) -> List[str]:

@@ -85,6 +85,15 @@ class PennTreeReader:
         def read(pos: int):
             assert toks[pos] == "(", f"expected '(' at token {pos}"
             pos += 1
+            if pos < len(toks) and toks[pos] == "(":
+                # PTB empty-label wrapper "( (S ...) )": synthesize a ROOT
+                # node so the ROOT/TOP unwrap below strips it uniformly
+                node = ConstituencyTree(tag="ROOT")
+                while pos < len(toks) and toks[pos] == "(":
+                    child, pos = read(pos)
+                    node.children.append(child)
+                assert toks[pos] == ")", f"expected ')' at token {pos}"
+                return node, pos + 1
             tag = toks[pos]
             pos += 1
             node = ConstituencyTree(tag=tag)
@@ -102,8 +111,8 @@ class PennTreeReader:
             if toks[i] != "(":
                 raise ValueError(f"unexpected token {toks[i]!r}")
             tree, i = read(i)
-            # PTB wraps trees in an extra unlabeled ( ... ); unwrap "( (S ..) )"
-            # readers produce tag="(" never, so handle ROOT-style wrappers
+            # unwrap single-child wrappers: explicit (ROOT ...)/(TOP ...) and
+            # the synthesized ROOT from PTB's unlabeled "( (S ..) )" form
             if tree.tag in ("ROOT", "TOP") and len(tree.children) == 1:
                 tree = tree.children[0]
             yield tree
@@ -137,15 +146,20 @@ def binarize(t: ConstituencyTree, factor: str = "left",
         node = kids[0]
         for i in range(1, len(kids) - 1):
             ctx = [k.tag for k in kids[max(0, i - horizontal_markov + 1): i + 1]]
-            node = ConstituencyTree(tag=f"@{t.tag}-({'-'.join(ctx)}",
+            node = ConstituencyTree(tag=f"@{t.tag}|{'-'.join(ctx)}",
                                     children=[node, kids[i]])
         return ConstituencyTree(tag=t.tag, children=[node, kids[-1]])
     node = kids[-1]
     for i in range(len(kids) - 2, 0, -1):
         ctx = [k.tag for k in kids[i: min(len(kids), i + horizontal_markov)]]
-        node = ConstituencyTree(tag=f"@{t.tag}-({'-'.join(ctx)}",
+        node = ConstituencyTree(tag=f"@{t.tag}|{'-'.join(ctx)}",
                                 children=[kids[i], node])
     return ConstituencyTree(tag=t.tag, children=[kids[0], node])
+
+
+def _base_tag(tag: str) -> str:
+    """Strip binarization ('@X|ctx') and PTB function ('NP-SBJ') decorations."""
+    return tag.lstrip("@").split("|")[0].split("-")[0]
 
 
 class HeadWordFinder:
@@ -179,11 +193,11 @@ class HeadWordFinder:
     def find_head_child(self, t: ConstituencyTree) -> ConstituencyTree:
         if t.is_leaf():
             return t
-        prios = self._RULES.get(t.tag.lstrip("@").split("-")[0])
+        prios = self._RULES.get(_base_tag(t.tag))
         if prios:
             for want in prios:
                 for c in t.children:
-                    if c.tag.lstrip("@").split("-")[0] == want:
+                    if _base_tag(c.tag) == want:
                         return c
         # default: rightmost child for VP-ish, leftmost otherwise (Collins
         # default direction condensed)

@@ -86,3 +86,31 @@ def test_svmlight_requires_features(conf_path, tmp_path):
 def test_missing_subcommand_errors():
     with pytest.raises(SystemExit):
         main([])
+
+
+def test_mem_uri_model_round_trip(conf_path, iris_csv, capsys):
+    """mem:// models persist for the process (ADVICE r02: a fresh store per
+    open_store call silently dropped every write), and a key directly after
+    the scheme must not create a literal local 'mem:' directory."""
+    import os
+
+    from deeplearning4j_tpu.cli.driver import main
+
+    for uri in ("mem://models/iris-params", "mem://iris-params.npz"):
+        rc = main(["train", "--conf", str(conf_path), "--input", str(iris_csv),
+                   "--model", uri, "--labels", "3", "--epochs", "2"])
+        assert rc == 0
+        rc = main(["test", "--conf", str(conf_path), "--input", str(iris_csv),
+                   "--model", uri, "--labels", "3"])
+        assert rc == 0
+        assert "Accuracy" in capsys.readouterr().out
+    assert not os.path.exists("mem:")
+
+
+def test_split_store_uri():
+    from deeplearning4j_tpu.scaleout.blobstore import split_store_uri
+
+    assert split_store_uri("mem://a/b/key.npz") == ("mem://a/b", "key.npz")
+    assert split_store_uri("mem://key.npz") == ("mem://", "key.npz")
+    assert split_store_uri("file:///d/key.npz") == ("file:///d", "key.npz")
+    assert split_store_uri("/d/key.npz") == ("/d", "key.npz")

@@ -184,3 +184,43 @@ class TestTreeVectorizer:
         batches = list(it)
         assert sum(len(b) for b in batches) == 3
         assert all(hasattr(t, "preorder") for b in batches for t in b)
+
+
+class TestAdviceRegressions:
+    def test_penn_reader_empty_label_wrapper(self):
+        """Standard PTB '( (S ...) )' form (ADVICE r02)."""
+        from deeplearning4j_tpu.text.corpora.treeparser import PennTreeReader
+
+        t = PennTreeReader.parse("( (S (NP (DT the) (NN cat)) (VP (VBD sat))) )")
+        assert t.tag == "S"
+        assert t.yield_words() == ["the", "cat", "sat"]
+
+    def test_binarized_tree_sexpr_reparses(self):
+        """binarize() labels must stay paren-free so to_sexpr round-trips
+        (ADVICE r02: '@X-(' labels broke PennTreeReader)."""
+        from deeplearning4j_tpu.text.corpora.treeparser import (
+            PennTreeReader, binarize)
+
+        t = PennTreeReader.parse(
+            "(NP (DT the) (JJ big) (JJ red) (NN cat))")
+        b = binarize(t)
+        rt = PennTreeReader.parse(b.to_sexpr())
+        assert rt.yield_words() == ["the", "big", "red", "cat"]
+
+    def test_to_infinitive_tagged_verb(self):
+        from deeplearning4j_tpu.text.corpora.pos import PosTagger
+
+        tags = PosTagger().tag(["to", "walk"])  # out-of-lexicon fallback
+        assert tags == ["TO", "VB"]
+        tags = PosTagger().tag(["to", "run"])  # lexicon-tagged verb
+        assert tags == ["TO", "VB"]
+
+    def test_head_finder_through_binarized_nodes(self):
+        """Fabricated '@X|ctx' labels must still match head-priority rules."""
+        from deeplearning4j_tpu.text.corpora.treeparser import (
+            HeadWordFinder, PennTreeReader, binarize)
+
+        t = binarize(PennTreeReader.parse(
+            "(VP (RB quickly) (VB run) (RB away))"))
+        head = HeadWordFinder().find_head(t)
+        assert head.word == "run"
