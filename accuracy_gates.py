@@ -15,7 +15,7 @@ LABELED synthetic — they are convergence proofs for the 784-input configs,
 never claimed as real-data accuracy. Real-MNIST gates are recorded as
 ``pending`` with the reason.
 
-Run:  python accuracy_gates.py  →  prints JSON and writes ACCURACY_r02.json
+Run:  python accuracy_gates.py  →  prints JSON and writes ACCURACY_r03.json
 """
 
 from __future__ import annotations
@@ -170,6 +170,50 @@ def gate_lenet_synthetic(epochs: int = 2, threshold: float = 0.97) -> dict:
                                 n=4000, n_train=3200)
 
 
+def gate_word2vec_real_corpus(iterations: int = 5) -> dict:
+    """Word2Vec on the reference's REAL 757k-word English corpus
+    (dl4j-test-resources raw_sentences.txt, mounted read-only — usable as
+    data with zero egress; ref Word2Vec tests train on this same file).
+    Asserts semantic clusters: numbers and day/night/week time words."""
+    from deeplearning4j_tpu.models.word2vec import Word2Vec
+    from deeplearning4j_tpu.text.sentence_iterator import LineSentenceIterator
+
+    path = ("/root/reference/dl4j-test-resources/src/main/resources/"
+            "raw_sentences.txt")
+    import os
+    if not os.path.exists(path):
+        # PENDING-style record: excluded from all_passed (see main)
+        return {"gate": "word2vec_real_corpus", "provenance": "real",
+                "skipped": "reference fixtures not mounted"}
+    vec = Word2Vec(sentence_iterator=LineSentenceIterator(path),
+                   layer_size=100, window=5, negative=5,
+                   iterations=iterations, min_word_frequency=5,
+                   sample=1e-3, batch_size=2048, lr=0.05, seed=7)
+    t0 = time.perf_counter()
+    vec.build_vocab()
+    vocab_wall = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    vec.fit()
+    wall = time.perf_counter() - t0
+    near_two = set(vec.words_nearest("two", 10))
+    near_day = set(vec.words_nearest("day", 10))
+    number_ok = bool(near_two & {"three", "four", "five", "six", "ten",
+                                 "Two", "Three"})
+    time_ok = bool(near_day & {"night", "week", "year", "time", "season",
+                               "morning", "days", "Today", "today", "every"})
+    return {"gate": "word2vec_real_corpus",
+            "dataset": "raw_sentences.txt (real English, 757k words, "
+                       "reference test fixture)",
+            "provenance": "real", "vocab_size": vec.vocab.num_words(),
+            "nearest_two": sorted(near_two), "nearest_day": sorted(near_day),
+            "number_cluster": number_ok, "time_cluster": time_ok,
+            "passed": number_ok and time_ok,
+            "train_pairs_per_sec": round(
+                vec.total_words_trained / max(wall, 1e-9), 1),
+            "vocab_build_wall_sec": round(vocab_wall, 2),
+            "train_wall_sec": round(wall, 2)}
+
+
 PENDING = [
     {"gate": "mnist_mlp_real", "reason": "MNIST IDX files absent and no "
      "network egress; fetcher auto-uses them at $MNIST_DIR or ~/MNIST when "
@@ -182,19 +226,24 @@ PENDING = [
 def main() -> None:
     gates = [
         gate_iris(),
+        gate_word2vec_real_corpus(),
         gate_digits_mlp(),
         gate_digits_conv(),
         gate_sda_digits(),
         gate_mnist_mlp_synthetic(),
         gate_lenet_synthetic(),
     ]
+    skipped = [g for g in gates if "skipped" in g]
+    gates = [g for g in gates if "skipped" not in g]
     out = {
         "real_data_gates": [g for g in gates if g["provenance"] == "real"],
         "synthetic_gates": [g for g in gates if g["provenance"] == "synthetic"],
-        "pending": PENDING,
+        "pending": PENDING + [
+            {"gate": g["gate"], "reason": g["skipped"]} for g in skipped
+        ],
         "all_passed": all(g["passed"] for g in gates),
     }
-    with open("ACCURACY_r02.json", "w") as f:
+    with open("ACCURACY_r03.json", "w") as f:
         json.dump(out, f, indent=2)
     print(json.dumps(out))
 
