@@ -194,9 +194,16 @@ def split_store_uri(path: str) -> tuple:
     scheme, sep, rest = path.partition("://")
     if not sep:
         base, _, key = path.rpartition("/")
+        # '/key.npz' (absolute root): empty base would resolve against CWD
+        if not base and path.startswith("/"):
+            base = "/"
         return base, key
     if "/" in rest:
         base, _, key = rest.rpartition("/")
+        # file:///key (absolute root) must keep the leading '/': an empty
+        # base would make LocalBlobStore resolve the key relative to CWD
+        if scheme == "file" and not base and rest.startswith("/"):
+            base = "/"
     else:
         base, key = "", rest
     return f"{scheme}://{base}", key

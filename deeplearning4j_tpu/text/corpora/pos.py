@@ -94,6 +94,7 @@ class PosTagger:
 
     def tag(self, tokens: Sequence[str]) -> List[str]:
         tags: List[str] = []
+        fallback: set = set()  # indices tagged NN only because nothing matched
         for i, tok in enumerate(tokens):
             low = tok.lower()
             if tok in _PUNCT:
@@ -110,16 +111,18 @@ class PosTagger:
                     tags.append(t)
                     break
             else:
+                fallback.add(i)
                 tags.append("NN")
         # context repair pass
         for i in range(1, len(tags)):
             prev = tags[i - 1]
             if prev in ("DT", "JJ", "PRP$") and tags[i] in ("VB", "VBP", "VBG", "VBD"):
                 tags[i] = "NN"
-            # infinitival "to <unknown>" prefers the verb reading ("to walk"):
-            # NN here can only be the out-of-lexicon fallback guess, and after
-            # TO an unknown token is far more likely a verb
-            elif prev == "TO" and tags[i] == "NN":
+            # infinitival "to <unknown>" prefers the verb reading ("to walk").
+            # Only the no-rule fallback NNs qualify: suffix-rule NNs
+            # (.*tion/.*ness/...) after prepositional "to" ("to perfection")
+            # are genuine nouns and must keep their tag.
+            elif prev == "TO" and tags[i] == "NN" and i in fallback:
                 tags[i] = "VB"
         return tags
 

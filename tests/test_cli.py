@@ -113,4 +113,7 @@ def test_split_store_uri():
     assert split_store_uri("mem://a/b/key.npz") == ("mem://a/b", "key.npz")
     assert split_store_uri("mem://key.npz") == ("mem://", "key.npz")
     assert split_store_uri("file:///d/key.npz") == ("file:///d", "key.npz")
+    # root-level keys keep the leading '/' (never CWD-relative)
+    assert split_store_uri("file:///key.npz") == ("file:///", "key.npz")
+    assert split_store_uri("/key.npz") == ("/", "key.npz")
     assert split_store_uri("/d/key.npz") == ("/d", "key.npz")

@@ -214,6 +214,9 @@ class TestAdviceRegressions:
         assert tags == ["TO", "VB"]
         tags = PosTagger().tag(["to", "run"])  # lexicon-tagged verb
         assert tags == ["TO", "VB"]
+        # prepositional "to" + suffix-rule noun must NOT be retagged VB
+        tags = PosTagger().tag(["to", "perfection"])
+        assert tags == ["TO", "NN"]
 
     def test_head_finder_through_binarized_nodes(self):
         """Fabricated '@X|ctx' labels must still match head-priority rules."""
