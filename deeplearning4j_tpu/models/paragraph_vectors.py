@@ -62,9 +62,8 @@ class ParagraphVectors(Word2Vec):
             ((rng.random((n_docs, d)) - 0.5) / d).astype(np.float32)
         )
         syn1neg = jnp.asarray(self.lookup_table.syn1neg)
-        probs_logits = jnp.asarray(
-            np.log(self.lookup_table.unigram_probs() + 1e-12)
-        )
+        from deeplearning4j_tpu.models.word2vec import build_neg_table
+        neg_table = build_neg_table(self.lookup_table.unigram_probs())
 
         # (doc, word) pairs
         docs_idx: List[int] = []
@@ -101,7 +100,7 @@ class ParagraphVectors(Word2Vec):
                 key, sub = jax.random.split(key)
                 doc_vecs, syn1neg, _ = _sgns_step(
                     doc_vecs, syn1neg, jnp.asarray(c), jnp.asarray(t),
-                    jnp.asarray(w), probs_logits, jnp.float32(lr), sub,
+                    jnp.asarray(w), neg_table, jnp.float32(lr), sub,
                     self.negative,
                 )
                 seen += int(w.sum())

@@ -99,6 +99,38 @@ def digits_conv(lr: float = 0.05, num_iterations: int = 1, seed: int = 42
     )
 
 
+def conv_wide(lr: float = 0.01, num_iterations: int = 1, seed: int = 42
+              ) -> MultiLayerConfiguration:
+    """Wide conv stack sized to FILL the MXU, unlike LeNet whose tiny
+    contractions (25 / 150 per im2col step) strand 128-wide lanes.
+
+    conv5x5 32→128ch on 32×32 input → pool2 → conv5x5 128→128 → pool2 →
+    dense256 → softmax10. The im2col contractions are 32·25=800 and
+    128·25=3200 wide with 128 output channels — exact MXU tile multiples
+    (nn/layers/convolution.py). Input is (batch, 32, 32, 32) NCHW; no
+    ff_to_conv preprocessor (multi-channel input enters 4-D directly).
+    """
+    return (
+        NeuralNetConfiguration.Builder()
+        .lr(lr).momentum(0.9).use_ada_grad(False)
+        .num_iterations(num_iterations).seed(seed)
+        .weight_init("SIZE").activation_function("relu")
+        .list(6)
+        .override(0, layer_type="CONVOLUTION", n_in=32, n_out=128,
+                  filter_size=(5, 5))
+        .override(1, layer_type="SUBSAMPLING", stride=(2, 2))
+        .override(2, layer_type="CONVOLUTION", n_in=128, n_out=128,
+                  filter_size=(5, 5))
+        .override(3, layer_type="SUBSAMPLING", stride=(2, 2))
+        .override(4, layer_type="DENSE", n_in=128 * 5 * 5, n_out=256)
+        .override(5, layer_type="OUTPUT", n_in=256, n_out=10,
+                  activation_function="softmax", loss_function="MCXENT")
+        .input_preprocessor(4, "conv_to_ff")
+        .pretrain(False).backward(True)
+        .build()
+    )
+
+
 def stacked_denoising_autoencoder(
     n_in: int = 784, hidden=(500, 250), n_out: int = 10,
     corruption_level: float = 0.3, lr: float = 0.1,

@@ -145,14 +145,19 @@ fused_dense.defvjp(_fused_dense_fwd, _fused_dense_bwd)
 
 def _lstm_gates_kernel(ifog_ref, c_ref, c_out_ref, h_out_ref):
     """(B, 4H) fused preactivations + (B, H) c_prev -> c_new, h_new.
-    Gate order i,f,o,g (ref LSTM.java iFog layout)."""
+    Gate order i,f,o,g (ref LSTM.java iFog layout).
+
+    Gate math runs in f32 regardless of the storage dtype: bf16
+    transcendentals trip a Mosaic broadcast-verifier bug on the axon
+    toolchain (round-4 finding), and f32 VPU math costs the same while
+    keeping the cell update numerically stable under the bf16 policy."""
     h = c_ref.shape[-1]
-    ifog = ifog_ref[:]
+    ifog = ifog_ref[:].astype(jnp.float32)
     i = jax.nn.sigmoid(ifog[:, 0 * h : 1 * h])
     f = jax.nn.sigmoid(ifog[:, 1 * h : 2 * h])
     o = jax.nn.sigmoid(ifog[:, 2 * h : 3 * h])
     gg = jnp.tanh(ifog[:, 3 * h : 4 * h])
-    c_new = f * c_ref[:] + i * gg
+    c_new = f * c_ref[:].astype(jnp.float32) + i * gg
     c_out_ref[:] = c_new.astype(c_out_ref.dtype)
     h_out_ref[:] = (o * jnp.tanh(c_new)).astype(h_out_ref.dtype)
 

@@ -63,7 +63,8 @@ class Word2VecWorkPerformer(WorkerPerformer):
                                     use_hs=False, negative=negative)
         self._syn0 = jnp.asarray(table.syn0)
         self._syn1neg = jnp.asarray(table.syn1neg)
-        self._probs_logits = jnp.log(jnp.asarray(table.unigram_probs()) + 1e-12)
+        from deeplearning4j_tpu.models.word2vec import build_neg_table
+        self._neg_table = build_neg_table(table.unigram_probs())
         self._key = jax.random.PRNGKey(seed)
         self._pairs_local = 0
 
@@ -93,7 +94,7 @@ class Word2VecWorkPerformer(WorkerPerformer):
         self._syn0, self._syn1neg, _ = self._step(
             jnp.array(self._syn0), jnp.array(self._syn1neg),
             jnp.asarray(centers), jnp.asarray(contexts), jnp.asarray(weights),
-            self._probs_logits, jnp.float32(lr), sub, negative=self.negative,
+            self._neg_table, jnp.float32(lr), sub, negative=self.negative,
         )
         n = int(centers.shape[0])
         self._pairs_local += n

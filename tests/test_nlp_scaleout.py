@@ -42,12 +42,12 @@ class TestDistributedWord2Vec:
         w2v.build_vocab()
         rng = np.random.default_rng(1)
         all_c, all_t = [], []
-        for _ in range(10):  # epochs of pairs
-            sents = w2v._sentence_indices(rng)
-            rng.shuffle(sents)
-            c, t = w2v._skipgram_pairs(sents, rng)
-            all_c.append(c)
-            all_t.append(t)
+        for _ in range(10):  # epochs of pairs, from the cached corpus index
+            flat, sid = w2v._subsampled_flat(rng)
+            c, t = w2v._pairs_from_flat(flat, sid, rng)
+            perm = rng.permutation(c.shape[0])
+            all_c.append(c[perm])
+            all_t.append(t[perm])
         centers = np.concatenate(all_c)
         contexts = np.concatenate(all_t)
 
