@@ -62,8 +62,9 @@ class ParagraphVectors(Word2Vec):
             ((rng.random((n_docs, d)) - 0.5) / d).astype(np.float32)
         )
         syn1neg = jnp.asarray(self.lookup_table.syn1neg)
-        from deeplearning4j_tpu.models.word2vec import build_neg_table
-        neg_table = build_neg_table(self.lookup_table.unigram_probs())
+        # cached device-resident unigram^0.75 table (shared with the word
+        # phase — rebuilding it per fit costs a 2^20 cumsum + ~4 MB upload)
+        neg_table = self._neg_table()
 
         # (doc, word) pairs
         docs_idx: List[int] = []

@@ -389,10 +389,10 @@ class Word2Vec:
         self._sid = np.zeros(0, np.int32)
         self._corpus_dev = None  # device-resident copy, uploaded once
         # device-resident embeddings carried across fit() calls (continued
-        # training never re-uploads), plus host snapshots to detect external
+        # training never re-uploads), plus content digests to detect external
         # modification of the lookup table between fits
         self._syn_dev = None
-        self._syn_host = None
+        self._syn_digest = None
         self._neg_table_dev = None   # unigram^0.75 table, uploaded once
         self._hs_tabs_dev = None     # Huffman path tables, uploaded once
 
@@ -436,6 +436,23 @@ class Word2Vec:
         self._corpus_dev = None   # new corpus index → re-upload on next fit
         self._neg_table_dev = None  # vocab changed → rebuild sampling tables
         self._hs_tabs_dev = None
+        self._syn_dev = None      # old-vocab embeddings: free device memory
+        self._syn_digest = None
+
+    @staticmethod
+    def _digest(arrays) -> tuple:
+        """Cheap content fingerprint of the embedding tables (sha1 over raw
+        bytes + shapes) — equality means the host tables are unchanged since
+        the last download, so the device copies can be reused."""
+        import hashlib
+
+        h = hashlib.sha1()
+        shapes = []
+        for a in arrays:
+            a = np.ascontiguousarray(a)
+            shapes.append(a.shape)
+            h.update(a.tobytes())
+        return (h.hexdigest(), tuple(shapes))
 
     # ---- pair generation (host side) ----
     def _keep_probs(self) -> np.ndarray:
@@ -537,11 +554,12 @@ class Word2Vec:
         # table still matches the snapshot we downloaded (each re-upload is a
         # full embedding-table host->device transfer); any external change —
         # serializer load, reset_weights, in-place edit — falls back to a
-        # fresh upload
+        # fresh upload. Change detection is by content digest, not a retained
+        # host copy: at 1M-vocab the three tables are ~400 MB each and a full
+        # duplicate would double host memory for a 20-byte check.
         cur = (table.syn0, table.syn1, table.syn1neg)
-        if self._syn_dev is not None and self._syn_host is not None and all(
-            c.shape == h.shape and np.array_equal(c, h)
-            for c, h in zip(cur, self._syn_host)
+        if self._syn_dev is not None and self._syn_digest is not None and (
+            self._digest(cur) == self._syn_digest
         ):
             syn0, syn1, syn1neg = self._syn_dev
         else:
@@ -566,9 +584,8 @@ class Word2Vec:
         if self.negative > 0:
             table.syn1neg = np.asarray(syn1neg)
         self._syn_dev = (syn0, syn1, syn1neg)
-        self._syn_host = tuple(
-            np.array(a, copy=True)
-            for a in (table.syn0, table.syn1, table.syn1neg))
+        self._syn_digest = self._digest(
+            (table.syn0, table.syn1, table.syn1neg))
         t_drain = _time.perf_counter() - t0
         self.last_fit_timings = {
             "host_pairgen_s": round(self._timings["pairgen"], 4),

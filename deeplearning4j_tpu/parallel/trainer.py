@@ -44,40 +44,58 @@ Array = jax.Array
 
 
 def _local_grad_step(conf, params, states, iteration, x, y, w, key,
-                     sync_grads: bool):
+                     sync_grads: bool, ablate_collectives: bool = False):
     """One update step over a weighted batch shard.
 
     ``w`` is a per-row weight (0 for padded rows). The loss is the weighted
-    mean of per-example losses; with ``sync_grads`` the normalizer is psum'd
-    across the data axis first, so the gradient on an uneven (padded) global
+    mean of per-example losses; with ``sync_grads`` the normalizer is the
+    psum'd global weight, so the gradient on an uneven (padded) global
     batch is EXACTLY the gradient of the unpadded batch — no duplicate-row
     bias (the reference sidesteps this by repartitioning the RDD,
     ref: SparkDl4jMultiLayer.java:164).
+
+    ``ablate_collectives`` (instrumentation only — scaling_bench.py) replaces
+    the psum with identity so the collective's wall-clock cost can be
+    measured by subtraction; the resulting math is per-shard-local and wrong
+    on purpose.
     """
-    from deeplearning4j_tpu.ops.losses import finalize_loss
+    from deeplearning4j_tpu.ops.losses import LossFunction, finalize_loss
 
     kdrop, _ = jax.random.split(key)
     head = conf.conf(conf.n_layers - 1)
 
     def loss_fn(ps):
         per = F.network_per_example_loss(conf, ps, x, y, train=True, key=kdrop)
-        lsum = jnp.sum(per * w)
-        wsum = jnp.sum(w)
-        if sync_grads:
-            # global weighted mean: psum the numerator and denominator so each
-            # shard differentiates the SAME scalar (psum transposes to
-            # identity, so per-shard grads are partial grads of the global
-            # loss; summing them below completes the chain rule)
-            lsum = jax.lax.psum(lsum, DATA_AXIS)
-            wsum = jax.lax.psum(wsum, DATA_AXIS)
-        wsum = jnp.maximum(wsum, 1e-8)  # all-padded shard in local mode
-        return finalize_loss(head.loss_function, lsum / wsum)
+        return jnp.sum(per * w), jnp.sum(w)
 
-    score, grads = jax.value_and_grad(loss_fn)(params)
     if sync_grads:
-        grads = jax.lax.psum(grads, DATA_AXIS)
+        # Differentiate the UNNORMALIZED per-shard loss sum (linear in the
+        # per-example losses), then do ONE fused psum over
+        # (grads, loss_sum, weight_sum) — a single XLA all-reduce group per
+        # step, with no collective inside the backward pass — and finish the
+        # chain rule in closed form: the global loss is
+        # finalize(Σlsum/Σwsum), so dL/dp = f'(mean)·Σgrads/Σwsum, where
+        # f' = 1 except RMSE_XENT's sqrt (f'(m) = 0.5/sqrt(m+eps) = 0.5/score).
+        (lsum, wsum), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        if not ablate_collectives:
+            grads, lsum, wsum = jax.lax.psum((grads, lsum, wsum), DATA_AXIS)
+        wsum = jnp.maximum(wsum, 1e-8)
+        mean = lsum / wsum
+        score = finalize_loss(head.loss_function, mean)
+        if LossFunction.coerce(head.loss_function) == LossFunction.RMSE_XENT:
+            chain = 0.5 / score / wsum
+        else:
+            chain = 1.0 / wsum
+        grads = jax.tree_util.tree_map(lambda g: g * chain, grads)
         upd_scale = jnp.float32(1.0)
     else:
+        def local_loss(ps):
+            lsum, ws = loss_fn(ps)
+            return finalize_loss(head.loss_function,
+                                 lsum / jnp.maximum(ws, 1e-8))
+
+        score, grads = jax.value_and_grad(local_loss)(params)
         # all-padded shard in local mode: freeze params entirely — otherwise
         # apply_updater's L1/L2 decay would still drift them on zero grads
         upd_scale = jnp.where(jnp.sum(w) > 0, 1.0, 0.0).astype(jnp.float32)
@@ -91,16 +109,20 @@ def _local_grad_step(conf, params, states, iteration, x, y, w, key,
     return tuple(new_params), tuple(new_states), score
 
 
-def make_sync_train_step(conf: MultiLayerConfiguration, mesh: Mesh):
+def make_sync_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
+                         ablate_collectives: bool = False):
     """Per-step averaging: grads AllReduced every iteration.
 
     step(params, states, iteration, x, y, w, key) — ``w`` is the per-row
     weight vector (0 = padded row), see _local_grad_step.
+
+    ``ablate_collectives`` is scaling-bench instrumentation (measures the
+    collective's cost by subtraction); never use it for training.
     """
 
     def step(params, states, iteration, x, y, w, key):
         return _local_grad_step(conf, params, states, iteration, x, y, w, key,
-                                True)
+                                True, ablate_collectives)
 
     sharded = jax.shard_map(
         step,
@@ -137,11 +159,11 @@ def make_local_fit_step(conf: MultiLayerConfiguration, mesh: Mesh,
         wsum = jnp.sum(w)
         wtot = jnp.maximum(jax.lax.psum(wsum, DATA_AXIS), 1e-8)
         frac = wsum / wtot
-        params = jax.lax.psum(
-            jax.tree_util.tree_map(lambda p: p * frac, params), DATA_AXIS)
-        states = jax.lax.psum(
-            jax.tree_util.tree_map(lambda s: s * frac, states), DATA_AXIS)
-        return params, states, jax.lax.psum(scores[-1] * frac, DATA_AXIS)
+        # one fused all-reduce group for params+states+score
+        params, states, score = jax.lax.psum(
+            jax.tree_util.tree_map(lambda t: t * frac,
+                                   (params, states, scores[-1])), DATA_AXIS)
+        return params, states, score
 
     sharded = jax.shard_map(
         local_fit,

@@ -21,6 +21,7 @@ multi-process setups.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor, wait
@@ -47,6 +48,8 @@ class LocalDistributedRunner:
         model_saver: Optional[ModelSaver] = None,
         max_rounds: int = 10_000,
         fault_tolerant: bool = False,
+        heartbeat_s: float = 0.002,
+        async_timeout_s: Optional[float] = None,
     ):
         """performer_factory() -> WorkerPerformer (one per worker, mirroring
         WorkerPerformerFactory, ref: scaleout/perform/WorkerPerformerFactory)."""
@@ -61,29 +64,50 @@ class LocalDistributedRunner:
         self.model_saver = model_saver
         self.max_rounds = max_rounds
         self.fault_tolerant = fault_tolerant
+        self.heartbeat_s = heartbeat_s  # master aggregation cadence (async
+        #                                 mode; ref: MasterActor 1 s tick)
+        self.async_timeout_s = async_timeout_s  # optional wall-clock cap for
+        #                                         the async path (None = run
+        #                                         until the iterator drains,
+        #                                         matching the sync path)
         self._requeued: deque = deque()  # jobs orphaned by failed workers
+        self._feed_lock = threading.Lock()  # guards iterator+requeued (async)
+        self._async_jobs_left = 0  # set by _train_async (max_rounds bound)
         for worker_id in self.performers:
             self.tracker.add_worker(worker_id)
 
-    def _worker_round(self, worker_id: str) -> None:
+    def _replicate_if_needed(self, worker_id: str) -> None:
+        """Pull the latest averaged params when flagged (ref:
+        WorkerActor.checkJobAvailable → tracker.getCurrent,
+        WorkerActor.java:302-306)."""
         performer: WorkerPerformer = self.performers[worker_id]
         if self.tracker.needs_replicate(worker_id):
             current = self.tracker.get_current()
             if current is not None:
                 performer.update(current)
             self.tracker.done_replicating(worker_id)
-        job = self.tracker.job_for(worker_id)
-        if job is None:
-            return
+
+    def _perform_and_publish(self, worker_id: str, job) -> None:
+        """Shared perform→publish protocol for the sync and async paths.
+
+        per-job timing counter (ref: WorkerActor heartbeat ms logging,
+        WorkerActor.java:198-202 / YARN WorkerNode StopWatch)."""
+        performer: WorkerPerformer = self.performers[worker_id]
         t0 = time.perf_counter()
         performer.perform(job)
-        # per-job timing counter (ref: WorkerActor heartbeat ms logging,
-        # WorkerActor.java:198-202 / YARN WorkerNode StopWatch)
         self.tracker.increment("job_ms_total",
                                (time.perf_counter() - t0) * 1000.0)
         self.tracker.add_update(worker_id, job)
         self.tracker.clear_job(worker_id)
         self.tracker.increment("jobs_done")
+        self.tracker.increment(f"rounds.{worker_id}")
+
+    def _worker_round(self, worker_id: str) -> None:
+        self._replicate_if_needed(worker_id)
+        job = self.tracker.job_for(worker_id)
+        if job is None:
+            return
+        self._perform_and_publish(worker_id, job)
 
     def _handle_worker_failure(self, worker_id: str, exc: BaseException) -> None:
         """Dead-worker recovery (ref: MasterActor stale-job GC + tracker
@@ -101,8 +125,13 @@ class LocalDistributedRunner:
             self._requeued.append(job)
 
     def train(self):
-        """Run rounds until the JobIterator is exhausted; returns the final
-        averaged flat param vector (tracker current)."""
+        """Run until the JobIterator is exhausted; returns the final
+        averaged flat param vector (tracker current).
+
+        Synchronous routers (IterativeReduce) barrier every round; an
+        ``asynchronous`` router (HogWild) runs the barrier-free path."""
+        if self.router.asynchronous:
+            return self._train_async()
         workers = list(self.performers)
         with ThreadPoolExecutor(max_workers=len(workers)) as pool:
             rounds = 0
@@ -152,5 +181,125 @@ class LocalDistributedRunner:
             # final aggregation of any straggler updates
             if self.tracker.updates():
                 self.router.update()
+        self.tracker.finish()
+        return self.tracker.get_current()
+
+    # ------------------------------------------------------------------
+    # asynchronous (Hogwild) execution — no per-round barrier
+    # ------------------------------------------------------------------
+
+    def _next_job(self, worker_id: str):
+        """Hand the calling worker its next job (requeued orphans first),
+        or None when the iterator is exhausted or the total-job bound
+        (max_rounds × initial worker count — the async analogue of the sync
+        path's per-round cap) is reached. Lock serializes only the hand-off,
+        never the work."""
+        with self._feed_lock:
+            if self._requeued:
+                job = self._requeued.popleft()
+                job.worker_id = worker_id
+                return job
+            if self._async_jobs_left <= 0:
+                return None
+            if self.job_iterator.has_next():
+                self._async_jobs_left -= 1
+                return self.job_iterator.next(worker_id)
+            return None
+
+    def _worker_loop(self, worker_id: str, stop: threading.Event) -> None:
+        """Continuous pull→perform→publish loop (ref: WorkerActor.java:168-206
+        heartbeat, minus the barrier: the worker never waits for peers or for
+        the master's aggregation)."""
+        while not stop.is_set():
+            self._replicate_if_needed(worker_id)
+            job = self._next_job(worker_id)
+            if job is None:
+                return
+            self.tracker.add_job(job)
+            self._perform_and_publish(worker_id, job)
+
+    def _train_async(self):
+        """Barrier-free Hogwild execution (ref: HogWildWorkRouter.java +
+        MasterActor heartbeat): every worker loops at its own pace; the
+        master aggregates whatever updates exist on each heartbeat tick, so
+        fast workers fold in many more rounds than slow ones and nobody
+        ever waits.
+
+        ``async_timeout_s`` is a GRACEFUL stop: past the deadline no new
+        jobs are handed out and the run ends once in-flight performs return
+        — a wedged perform() still blocks (exactly as it would block the
+        sync path's barrier); Python threads cannot be killed."""
+        stop = threading.Event()
+        workers = list(self.performers)
+        self._async_jobs_left = self.max_rounds * max(len(workers), 1)
+        deadline = (time.monotonic() + self.async_timeout_s
+                    if self.async_timeout_s is not None else None)
+        with ThreadPoolExecutor(max_workers=len(workers)) as pool:
+            futures = {w: pool.submit(self._worker_loop, w, stop)
+                       for w in workers}
+            last_save = 0.0
+            try:
+                while any(not f.done() for f in futures.values()):
+                    time.sleep(self.heartbeat_s)
+                    # master heartbeat: aggregate whatever has arrived
+                    if self.router.send_work() and self.tracker.updates():
+                        self.router.update()
+                        self.tracker.increment("aggregations")
+                        # save at most once per second (ref: MasterActor's
+                        # 1 s tick / ModelSavingActor per MoreWorkMessage) —
+                        # the aggregation heartbeat can be far hotter than
+                        # any model serialization should be
+                        now = time.monotonic()
+                        if (self.model_saver is not None
+                                and now - last_save >= 1.0):
+                            current = self.tracker.get_current()
+                            if current is not None:
+                                self.model_saver.save(current)
+                                last_save = now
+                    if deadline is not None and time.monotonic() > deadline:
+                        log.warning("async train: async_timeout_s hit, "
+                                    "stopping with jobs unfinished")
+                        with self._feed_lock:
+                            # no fresh jobs after the deadline — the drain
+                            # below may still reroute already-issued orphans
+                            self._async_jobs_left = 0
+                        break
+            finally:
+                stop.set()
+            failures = []
+            for w, f in futures.items():
+                exc = f.exception()
+                if exc is None:
+                    continue
+                if not self.fault_tolerant:
+                    raise exc
+                self._handle_worker_failure(w, exc)
+                failures.append(w)
+            if failures and not self.performers:
+                raise RuntimeError("all workers failed")
+            # drain jobs orphaned by failed workers on the survivors
+            # (repeat in case a survivor fails mid-drain)
+            while self._requeued:
+                if not self.performers:
+                    raise RuntimeError("all workers failed")
+                stop2 = threading.Event()
+                futures = {w: pool.submit(self._worker_loop, w, stop2)
+                           for w in list(self.performers)}
+                wait(futures.values())
+                for w, f in futures.items():
+                    exc = f.exception()
+                    if exc is not None:
+                        if not self.fault_tolerant:
+                            raise exc
+                        self._handle_worker_failure(w, exc)
+        # final aggregation of straggler updates + final model save (the
+        # 1 s throttle above may have skipped the last in-loop save)
+        if self.tracker.updates():
+            self.router.update()
+            self.tracker.increment("aggregations")
+        if self.model_saver is not None:
+            current = self.tracker.get_current()
+            if current is not None:
+                self.model_saver.save(current)
         self.tracker.finish()
         return self.tracker.get_current()

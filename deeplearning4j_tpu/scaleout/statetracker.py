@@ -32,7 +32,12 @@ class StateTracker:
     # updates
     def add_update(self, worker_id: str, job: Job) -> None: raise NotImplementedError
     def updates(self) -> Dict[str, Job]: raise NotImplementedError
-    def clear_updates(self) -> None: raise NotImplementedError
+    def clear_updates(self, expected: Optional[Dict[str, Job]] = None) -> None:
+        """Clear updates. With ``expected`` (a prior updates() snapshot),
+        remove ONLY entries still identical to the snapshot — an update a
+        worker published after the snapshot survives for the next
+        aggregation round (barrier-free Hogwild would otherwise lose it)."""
+        raise NotImplementedError
     # current (averaged) result
     def set_current(self, result: Any) -> None: raise NotImplementedError
     def get_current(self) -> Any: raise NotImplementedError
@@ -103,9 +108,14 @@ class InMemoryStateTracker(StateTracker):
         with self._lock:
             return dict(self._updates)
 
-    def clear_updates(self) -> None:
+    def clear_updates(self, expected: Optional[Dict[str, Job]] = None) -> None:
         with self._lock:
-            self._updates.clear()
+            if expected is None:
+                self._updates.clear()
+                return
+            for worker_id, job in expected.items():
+                if self._updates.get(worker_id) is job:
+                    del self._updates[worker_id]
 
     # ---- current result ----
     def set_current(self, result: Any) -> None:

@@ -13,6 +13,11 @@ from deeplearning4j_tpu.scaleout.statetracker import StateTracker
 
 
 class WorkRouter:
+    #: True when workers run barrier-free and the master aggregates on its
+    #: own cadence (ref: HogWildWorkRouter — MasterActor's heartbeat calls
+    #: sendWork() every tick regardless of worker progress).
+    asynchronous = False
+
     def __init__(self, tracker: StateTracker, aggregator: JobAggregator):
         self.tracker = tracker
         self.aggregator = aggregator
@@ -23,7 +28,15 @@ class WorkRouter:
 
     def update(self) -> None:
         """Aggregate worker updates into the tracker's current params and
-        flag every worker for replication (ref: BaseWorkRouter.update)."""
+        flag every worker for replication (ref: BaseWorkRouter.update).
+
+        Only the snapshotted updates are cleared: an update published
+        between updates() and clear_updates() stays for the next round.
+        Note the tracker keeps ONE slot per worker holding its latest FULL
+        param snapshot (ref: LocalFileUpdateSaver keyed by worker id) — a
+        newer snapshot from the same worker supersedes an un-aggregated
+        older one (it embeds that training), and the identity check here
+        guarantees a newer-unseen snapshot is never deleted unaggregated."""
         updates = self.tracker.updates()
         for job in updates.values():
             self.aggregator.accumulate(job)
@@ -32,7 +45,7 @@ class WorkRouter:
             self.tracker.set_current(result)
         for worker_id in self.tracker.workers():
             self.tracker.add_replicate(worker_id)
-        self.tracker.clear_updates()
+        self.tracker.clear_updates(updates)
         if hasattr(self.aggregator, "reset"):
             self.aggregator.reset()
 
@@ -46,7 +59,13 @@ class IterativeReduceWorkRouter(WorkRouter):
 
 
 class HogWildWorkRouter(WorkRouter):
-    """Asynchronous: always route (ref: HogWildWorkRouter.java)."""
+    """Asynchronous: always route (ref: HogWildWorkRouter.java). With
+    ``asynchronous=True`` the runner drops its per-round barrier entirely —
+    workers pull/perform/publish continuously at their own pace (ref:
+    WorkerActor.java:168-206) while the master aggregates whatever updates
+    exist on each heartbeat."""
+
+    asynchronous = True
 
     def send_work(self) -> bool:
         return True

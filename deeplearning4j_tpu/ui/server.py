@@ -18,8 +18,10 @@ INDEX_HTML = """<!doctype html>
 <h1>deeplearning4j-tpu</h1>
 <h2>views</h2>
 <ul>
-<li><a href="/render/tsne">t-SNE scatter</a></li>
+<li><a href="/render/tsne">t-SNE scatter (pan/zoom)</a></li>
 <li><a href="/render/weights">weight histograms</a></li>
+<li><a href="/render/filters">learned filters</a></li>
+<li><a href="/render/activations">layer activations</a></li>
 <li><a href="/render/words">nearest-neighbour explorer</a></li>
 </ul>
 <h2>api</h2>
@@ -28,6 +30,8 @@ INDEX_HTML = """<!doctype html>
 <li><a href="/api/nearest?word=WORD&n=5">nearest neighbours</a></li>
 <li><a href="/api/tsne">t-SNE coords</a></li>
 <li><a href="/api/weights">weight histograms</a></li>
+<li><a href="/api/filters">filter tiles</a></li>
+<li><a href="/api/activations">activation heatmaps</a></li>
 <li><a href="/artifacts/">artifact files</a></li>
 </ul></body></html>"""
 
@@ -48,6 +52,8 @@ class UiServer:
         self._vptree = None
         self._tsne: Optional[Dict] = None
         self._weights: Optional[Dict] = None
+        self._filters: Optional[list] = None
+        self._activations: Optional[list] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.port: Optional[int] = None
@@ -70,6 +76,16 @@ class UiServer:
 
     def upload_weight_histograms(self, histograms: Dict) -> None:
         self._weights = histograms
+
+    def upload_filters(self, net, max_filters: int = 64) -> None:
+        """Extract + register learned-filter tiles from a trained network
+        (ref: FilterRenderer.renderFilters fed by NeuralNetPlotter)."""
+        self._filters = views.filter_grids(net, max_filters=max_filters)
+
+    def upload_activations(self, net, x) -> None:
+        """Register per-layer activation heatmaps for a batch
+        (ref: NeuralNetPlotter.plotActivations)."""
+        self._activations = views.activation_summaries(net, x)
 
     # ---- queries ----
     def nearest(self, word: str, n: int = 5) -> List[Dict]:
@@ -125,6 +141,10 @@ class UiServer:
                     self._json(ui._tsne or {})
                 elif url.path == "/api/weights":
                     self._json(ui._weights or {})
+                elif url.path == "/api/filters":
+                    self._json({"grids": ui._filters or []})
+                elif url.path == "/api/activations":
+                    self._json({"layers": ui._activations or []})
                 elif url.path.startswith("/artifacts/") and ui.artifact_dir:
                     from urllib.parse import unquote
 

@@ -1,20 +1,37 @@
-"""Scaling-efficiency benchmark (BASELINE config #5 analogue).
+"""Scaling-efficiency benchmark with collective-vs-compute breakdown
+(BASELINE config #5 analogue).
 
 Measures the synchronous data-parallel training step (in-graph gradient
 AllReduce — the XLA-native rewrite of the reference's per-iteration
 ParameterAveraging loop, ref: spark/impl/multilayer/SparkDl4jMultiLayer.java:183-203)
 at 1/2/4/8 virtual CPU devices, fixed per-device batch (weak scaling).
 
-Virtual CPU "devices" share one socket's cores, so wall-clock does NOT scale
-the way chips over ICI do (n=1 gets every core to itself; n=8 contend).
-The honest metric on this host is **DP overhead**: the sharded step at n
-devices vs the SAME global batch on a single device — identical total FLOPs
-on identical silicon, so any gap is sharding + collective overhead. Ideal is
-1.0; on real chips over ICI the same code's overhead is one gradient-pytree
-AllReduce per step (see parallel/trainer.py). This is the reference's own
-test posture (Spark local[8] — also one socket).
+Three timings per device count n (global batch = 256·n):
+  dp_ms      — the real sharded step (compute + sharding machinery + psum)
+  ablated_ms — the SAME sharded step with the psum replaced by identity
+               (trainer.make_sync_train_step(ablate_collectives=True)):
+               identical compute and sharding machinery, no collective
+  single_ms  — the same global batch as ONE un-sharded step on 1 device:
+               identical total FLOPs on identical silicon
 
-Run:  python scaling_bench.py  →  prints JSON and writes SCALING_r02.json
+Decomposition:
+  collective_ms    = dp_ms − ablated_ms     (the AllReduce itself)
+  mesh_overhead_ms = ablated_ms − single_ms (virtual-mesh artifact: n
+                     per-shard executions dispatched onto the SAME host
+                     core(s), losing the one-big-matmul batching the single
+                     -device run gets — this term does not exist on real
+                     chips, where each shard owns its silicon)
+  dp_overhead_efficiency   = single_ms / dp_ms   (the honest virtual-mesh
+                             number; ideal 1.0)
+  collective_only_efficiency = single_ms / (single_ms + collective_ms)
+                             (what remains once each shard owns its compute
+                             — the framework-attributable share)
+
+Virtual CPU "devices" share the host's core(s) (`nproc` is recorded in the
+artifact), so wall-clock cannot weak-scale here; the reference's own test
+posture has the same property (Spark local[8] on one socket).
+
+Run:  python scaling_bench.py  →  prints JSON and writes SCALING_r04.json
 """
 
 from __future__ import annotations
@@ -27,6 +44,8 @@ import sys
 PER_DEVICE_BATCH = 256
 STEPS = 30
 WARMUP = 5
+REPEATS = 3
+OUT = "SCALING_r04.json"
 
 _CHILD = r"""
 import sys, time, json
@@ -43,70 +62,145 @@ from deeplearning4j_tpu.parallel.trainer import make_sync_train_step
 
 n = int(sys.argv[1])
 batch = int(sys.argv[2])
+ablate = sys.argv[3] == "ablate"
 conf = mnist_mlp(256, 128)
 params = F.init_params(conf, jax.random.PRNGKey(0))
 states = F.init_train_state(conf, params)
 mesh = data_parallel_mesh(n)
-step = make_sync_train_step(conf, mesh)
+step = make_sync_train_step(conf, mesh, ablate_collectives=ablate)
 
 key = jax.random.PRNGKey(1)
 x = jax.random.uniform(key, (batch, 784), jnp.float32)
 y = jax.nn.one_hot(jax.random.randint(key, (batch,), 0, 10), 10, dtype=jnp.float32)
 w = jnp.ones((batch,), jnp.float32)
 
+lowered = step.lower(params, states, jnp.asarray(0), x, y, w, key)
+hlo = lowered.compile().as_text()
+n_allreduce = hlo.count("all-reduce-start") or hlo.count(" all-reduce(")
+param_bytes = sum(int(jnp.size(l)) * 4 for layer in params
+                  for l in jax.tree_util.tree_leaves(layer))
+
 for i in range({warmup}):
     params, states, score = step(params, states, jnp.asarray(i), x, y, w, key)
 jax.block_until_ready(params)
-t0 = time.perf_counter()
-for i in range({steps}):
-    params, states, score = step(params, states, jnp.asarray(i), x, y, w, key)
-jax.block_until_ready(params)
-dt = time.perf_counter() - t0
+# best-of-R repeats: a 1-core host makes single timings noisy under any
+# transient background load; the minimum is the uncontended step time
+best = float("inf")
+for _ in range({repeats}):
+    t0 = time.perf_counter()
+    for i in range({steps}):
+        params, states, score = step(params, states, jnp.asarray(i), x, y, w, key)
+    jax.block_until_ready(params)
+    best = min(best, time.perf_counter() - t0)
+dt = best
 assert bool(jnp.isfinite(score)), "non-finite score"
-print("MS", dt / {steps} * 1000.0)
+print("RES", json.dumps({{"ms": dt / {steps} * 1000.0,
+                          "all_reduce_ops": n_allreduce,
+                          "param_bytes": param_bytes}}))
 """
 
 
-def measure(n_devices: int, global_batch: int) -> float:
-    """Per-step milliseconds at n virtual CPU devices (fresh subprocess — the
-    device count is fixed at backend init)."""
+def measure(n_devices: int, global_batch: int, mode: str = "dp") -> dict:
+    """Per-step stats at n virtual CPU devices (fresh subprocess — the
+    device count is fixed at backend init). mode: dp | ablate."""
     code = _CHILD.format(repo=os.path.dirname(os.path.abspath(__file__)),
-                         warmup=WARMUP, steps=STEPS)
+                         warmup=WARMUP, steps=STEPS, repeats=REPEATS)
     out = subprocess.run(
-        [sys.executable, "-c", code, str(n_devices), str(global_batch)],
+        [sys.executable, "-c", code, str(n_devices), str(global_batch), mode],
         capture_output=True, text=True, timeout=600)
     for line in out.stdout.splitlines():
-        if line.startswith("MS "):
-            return float(line.split()[1])
+        if line.startswith("RES "):
+            return json.loads(line[4:])
     raise RuntimeError(f"scaling child failed (n={n_devices}):\n{out.stderr[-2000:]}")
 
 
 def main() -> None:
+    nproc = os.cpu_count()
     rows = []
+    param_bytes = None
     for n in (1, 2, 4, 8):
         gb = PER_DEVICE_BATCH * n
-        dp_ms = measure(n, gb)
-        single_ms = dp_ms if n == 1 else measure(1, gb)
+        dp = measure(n, gb, "dp")
+        param_bytes = dp["param_bytes"]
+        dp_ms = dp["ms"]
+        if n == 1:
+            abl_ms = dp_ms
+            single_ms = dp_ms
+        else:
+            abl_ms = measure(n, gb, "ablate")["ms"]
+            single_ms = measure(1, gb, "dp")["ms"]
+        coll_ms = max(dp_ms - abl_ms, 0.0)
         rows.append({
             "devices": n,
             "per_device_batch": PER_DEVICE_BATCH,
             "global_batch": gb,
-            "dp_step_ms": round(dp_ms, 2),
-            "single_device_same_batch_ms": round(single_ms, 2),
+            "dp_step_ms": round(dp_ms, 3),
+            "ablated_step_ms": round(abl_ms, 3),
+            "single_device_same_batch_ms": round(single_ms, 3),
+            "collective_ms": round(coll_ms, 3),
+            "mesh_overhead_ms": round(abl_ms - single_ms, 3),
             "dp_overhead_efficiency": round(single_ms / dp_ms, 3),
+            "collective_only_efficiency": round(
+                single_ms / (single_ms + coll_ms), 3),
+            "all_reduce_ops_per_step": dp["all_reduce_ops"],
             "global_samples_per_sec": round(gb / (dp_ms / 1000.0), 1),
         })
+    r8 = rows[-1]
+    # ICI projection: one fused all-reduce of the grad pytree per step.
+    # Ring all-reduce moves 2·(n−1)/n·payload per link; v5e ICI ≈ 45 GB/s
+    # per direction per link, so the wire time at n=8 is ~tens of µs
+    # against a per-shard compute of single_ms(256) — the measured
+    # collective_ms here instead rides host memcpy on nproc core(s).
+    ici_bw = 45e9
+    wire_s = 2 * (8 - 1) / 8 * param_bytes / ici_bw
+    shard_compute_ms = rows[0]["dp_step_ms"]  # batch 256 on one device
     out = {
-        "protocol": "sync DP (in-graph gradient AllReduce), MLP "
-                    "784-256-128-10 fp32, virtual CPU mesh. "
-                    "dp_overhead_efficiency = same-global-batch single-device "
-                    "step time / sharded step time (cores are shared across "
-                    "virtual devices, so this isolates sharding+collective "
-                    "overhead; ideal 1.0). Ref posture: Spark local[8], "
-                    "SparkDl4jMultiLayer.java:183-203",
+        "protocol": "sync DP (ONE fused in-graph gradient AllReduce/step), "
+                    "MLP 784-256-128-10 fp32, virtual CPU mesh, weak scaling "
+                    "at 256 samples/device. dp_overhead_efficiency = "
+                    "same-global-batch single-device step / sharded step "
+                    "(identical FLOPs on identical silicon; ideal 1.0). "
+                    "ablated_step_ms re-runs the identical sharded program "
+                    "with psum ablated, so collective_ms = dp − ablated and "
+                    "mesh_overhead_ms = ablated − single isolate the "
+                    "AllReduce from the virtual-mesh artifact. Ref posture: "
+                    "Spark local[8], SparkDl4jMultiLayer.java:183-203",
+        "host": {"nproc": nproc, "platform": "cpu (virtual devices)"},
+        "grad_allreduce_payload_bytes": param_bytes,
         "scaling": rows,
+        "analysis": {
+            "binding_constraint": (
+                f"This host exposes nproc={nproc} core(s); all {rows[-1]['devices']} "
+                "virtual devices time-share it. mesh_overhead_ms (ablated − "
+                "single) is therefore serialization of n per-shard programs "
+                "on shared core(s) + the loss of single-kernel batching — an "
+                "artifact with no analogue on a real pod, where each chip "
+                "owns its MXU. The framework-attributable cost is "
+                "collective_ms only: the single fused AllReduce the step "
+                "issues (all_reduce_ops_per_step confirms the count from "
+                "compiled HLO)."),
+            "two_device_real_vs_ideal": (
+                f"n=2: dp={rows[1]['dp_step_ms']}ms vs ideal(single, same "
+                f"batch)={rows[1]['single_device_same_batch_ms']}ms; the gap "
+                f"splits into mesh_overhead={rows[1]['mesh_overhead_ms']}ms "
+                f"(virtual-mesh serialization, vanishes on 2 real chips) + "
+                f"collective={rows[1]['collective_ms']}ms (the AllReduce)."),
+            "ici_projection": {
+                "payload_mb": round(param_bytes / 1e6, 3),
+                "ring_allreduce_wire_us_at_8x45GBps": round(wire_s * 1e6, 1),
+                "per_shard_compute_ms_b256": shard_compute_ms,
+                "projected_efficiency_8_chips": round(
+                    shard_compute_ms
+                    / (shard_compute_ms + wire_s * 1e3), 4),
+                "note": "on real v5e ICI the fused grad AllReduce wire time "
+                        "is ~2 orders below per-shard compute; the measured "
+                        "collective_ms here is host-memcpy-bound and is an "
+                        "upper bound on the framework's collective cost",
+            },
+            "collective_only_efficiency_8": r8["collective_only_efficiency"],
+        },
     }
-    with open("SCALING_r02.json", "w") as f:
+    with open(OUT, "w") as f:
         json.dump(out, f, indent=2)
     print(json.dumps(out))
 

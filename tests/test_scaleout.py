@@ -119,6 +119,89 @@ def test_hogwild_router_runs():
     assert runner.train() is not None
 
 
+def test_hogwild_async_workers_make_unequal_progress():
+    """The async path has NO per-round barrier: a slow worker must not gate
+    a fast one (ref: HogWildWorkRouter.sendWork always true + WorkerActor's
+    continuous pull loop, WorkerActor.java:168-206). With the old lockstep
+    runner both workers would finish the same number of rounds."""
+    import time as _time
+
+    from deeplearning4j_tpu.scaleout.job import CollectionJobIterator
+    from deeplearning4j_tpu.scaleout.perform import WorkerPerformer
+
+    class PacedPerformer(WorkerPerformer):
+        def __init__(self, delay_s):
+            self.delay_s = delay_s
+
+        def perform(self, job):
+            _time.sleep(self.delay_s)
+            job.result = np.asarray([float(job.work)])
+
+        def update(self, *args):
+            pass
+
+    delays = iter([0.05, 0.001])  # worker-0 is 50x slower than worker-1
+    tracker = InMemoryStateTracker()
+    runner = LocalDistributedRunner(
+        performer_factory=lambda: PacedPerformer(next(delays)),
+        job_iterator=CollectionJobIterator(list(range(24))),
+        num_workers=2,
+        tracker=tracker,
+        router=HogWildWorkRouter(tracker, ParameterAveragingAggregator()),
+    )
+    runner.train()
+    assert tracker.count("jobs_done") == 24
+    slow = tracker.count("rounds.worker-0")
+    fast = tracker.count("rounds.worker-1")
+    assert fast >= 3 * max(slow, 1), (slow, fast)
+    # the master aggregated on its own cadence while workers ran
+    assert tracker.count("aggregations") >= 2
+
+
+def test_hogwild_async_training_converges():
+    """Async Hogwild with a deliberately slow straggler still converges on
+    Iris — staleness-tolerant averaging (ref: HogWildWorkRouter semantics)."""
+    import time as _time
+
+    conf_json = iris_conf_json(num_iterations=15)
+
+    class SlowFirstWorkerFactory:
+        def __init__(self):
+            self.n = 0
+
+        def __call__(self):
+            performer = MultiLayerNetworkWorkPerformer(conf_json)
+            if self.n == 0:
+                inner = performer.perform
+
+                def slow_perform(job):
+                    _time.sleep(0.05)
+                    inner(job)
+
+                performer.perform = slow_perform
+            self.n += 1
+            return performer
+
+    tracker = InMemoryStateTracker()
+    runner = LocalDistributedRunner(
+        performer_factory=SlowFirstWorkerFactory(),
+        job_iterator=DataSetJobIterator(IrisDataSetIterator(25, 150)),
+        num_workers=2,
+        tracker=tracker,
+        router=HogWildWorkRouter(tracker, ParameterAveragingAggregator()),
+    )
+    final_params = runner.train()
+    assert final_params is not None
+    assert tracker.count("jobs_done") == 6
+
+    net = MultiLayerNetwork.from_json(conf_json)
+    net.init()
+    net.set_params(final_params)
+    full = IrisDataSetIterator(150, 150).next()
+    acc = (net.predict(full.features) == full.labels.argmax(-1)).mean()
+    assert acc > 0.6, acc
+
+
 def test_collection_job_iterator():
     it = CollectionJobIterator([1, 2, 3])
     seen = []

@@ -91,6 +91,72 @@ def test_render_views_serve_html(server):
         assert marker in body and b"<script>" in body
 
 
+def test_filters_and_activations_from_trained_conv_net():
+    """/render/filters and /render/activations serve artifacts extracted
+    from an ACTUAL training run on a conv net (ref: FilterRenderer.java +
+    NeuralNetPlotter.plotActivations feeding the webapp)."""
+    from deeplearning4j_tpu.models.zoo import digits_conv
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    rng = np.random.RandomState(3)
+    x = rng.rand(16, 64).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 16)]
+    net = MultiLayerNetwork(digits_conv(num_iterations=2)).init()
+    net.fit(x, y)
+
+    s = UiServer()
+    s.upload_filters(net)
+    s.upload_activations(net, x[:8])
+    s.start(port=0)
+    try:
+        _, body = _get(s, "/api/filters")
+        grids = json.loads(body)["grids"]
+        assert grids, "no filter grids extracted"
+        conv = grids[0]
+        assert conv["name"] == "layer0/convweights"
+        assert conv["width"] == 3 and conv["height"] == 3
+        assert len(conv["tiles"]) == 16
+        flat = [v for t in conv["tiles"] for row in t for v in row]
+        assert max(flat) <= 1.0 and min(flat) >= 0.0
+
+        _, body = _get(s, "/api/activations")
+        layers = json.loads(body)["layers"]
+        assert len(layers) >= 4  # conv, pool, dense, output
+        assert layers[0]["rows"] == 8
+        assert all(np.isfinite(L["mean"]) for L in layers)
+
+        for path in ("/render/filters", "/render/activations"):
+            status, body = _get(s, path)
+            assert status == 200 and b"<script>" in body
+    finally:
+        s.stop()
+
+
+def test_mlp_first_layer_filters_square_input():
+    """A square-input dense first layer renders per-unit weight images
+    (ref: FilterRenderer on RBM/dense W columns)."""
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.ui.views import filter_grids
+
+    conf = (
+        NeuralNetConfiguration.Builder()
+        .n_in(64).n_out(12).activation_function("tanh").list(2)
+        .override(1, layer_type="OUTPUT", n_in=12, n_out=3,
+                  activation_function="softmax", loss_function="MCXENT")
+        .pretrain(False).backward(True).build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    grids = filter_grids(net)
+    assert grids and grids[0]["name"] == "layer0/W"
+    assert grids[0]["width"] == 8 and len(grids[0]["tiles"]) == 12
+
+
+def test_tsne_view_has_pan_zoom(server):
+    _, body = _get(server, "/render/tsne")
+    assert b"viewBox" in body and b"wheel" in body and b"dblclick" in body
+
+
 def test_weight_histograms_helper():
     from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
