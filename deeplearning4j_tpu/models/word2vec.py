@@ -406,38 +406,77 @@ class Word2Vec:
         epoch in a Python loop, starving the device at corpus scale
         (VERDICT r02 weak #7)."""
         assert self.sentence_iterator is not None, "no sentence iterator configured"
-        corpus_tokens: List[List[str]] = []
-        for sentence in self.sentence_iterator:
-            toks = self.tokenizer_factory.create(sentence).get_tokens()
-            corpus_tokens.append(toks)
-            for tok in toks:
-                self.vocab.add_token(tok)
-        self.vocab.finish(self.min_word_frequency)
+        native = self._native_vocab_index()
+        if native is not None:
+            words, counts, self._flat, self._sid = native
+            for w, c in zip(words, counts):
+                self.vocab.add_token(w, by=int(c))
+            self.vocab.finish(self.min_word_frequency)
+        else:
+            corpus_tokens: List[List[str]] = []
+            for sentence in self.sentence_iterator:
+                toks = self.tokenizer_factory.create(sentence).get_tokens()
+                corpus_tokens.append(toks)
+                for tok in toks:
+                    self.vocab.add_token(tok)
+            self.vocab.finish(self.min_word_frequency)
+            # index the cached corpus: one flat array + sentence ids
+            index_of = self.vocab.index_of
+            sents = []
+            for toks in corpus_tokens:
+                idx = np.array(
+                    [i for i in (index_of(t) for t in toks) if i >= 0],
+                    dtype=np.int32)
+                if idx.size >= 2:
+                    sents.append(idx)
+            if sents:
+                self._flat = np.concatenate(sents)
+                self._sid = np.repeat(np.arange(len(sents), dtype=np.int32),
+                                      [s.size for s in sents])
+            else:
+                self._flat = np.zeros(0, np.int32)
+                self._sid = np.zeros(0, np.int32)
         build_huffman(self.vocab)
         self.lookup_table = InMemoryLookupTable(
             self.vocab, self.layer_size, seed=self.seed,
             use_hs=self.use_hs, negative=self.negative,
         )
-        # index the cached corpus: one flat array + sentence ids
-        index_of = self.vocab.index_of
-        sents = []
-        for toks in corpus_tokens:
-            idx = np.array([i for i in (index_of(t) for t in toks) if i >= 0],
-                           dtype=np.int32)
-            if idx.size >= 2:
-                sents.append(idx)
-        if sents:
-            self._flat = np.concatenate(sents)
-            self._sid = np.repeat(np.arange(len(sents), dtype=np.int32),
-                                  [s.size for s in sents])
-        else:
-            self._flat = np.zeros(0, np.int32)
-            self._sid = np.zeros(0, np.int32)
         self._corpus_dev = None   # new corpus index → re-upload on next fit
         self._neg_table_dev = None  # vocab changed → rebuild sampling tables
         self._hs_tabs_dev = None
         self._syn_dev = None      # old-vocab embeddings: free device memory
         self._syn_digest = None
+
+    def _native_vocab_index(self):
+        """C++ tokenize+count+index fast path (native/text.cpp via
+        native/lib.py corpus_index) — the host-side vocab-build hot path the
+        reference runs on a JVM actor pool (Word2Vec.java vocab phase +
+        VocabActor). Applies only when it is PROVABLY equivalent to the
+        Python path: plain whitespace tokenizer with no pre-processor, a
+        fresh vocab, and ASCII text (byte-wise split/sort == str semantics);
+        returns None otherwise and the Python path runs."""
+        from deeplearning4j_tpu.native.lib import corpus_index, native_available
+        from deeplearning4j_tpu.text.tokenization import DefaultTokenizerFactory
+
+        if type(self.tokenizer_factory) is not DefaultTokenizerFactory:
+            return None
+        if self.tokenizer_factory.pre_processor is not None:
+            return None
+        if not self.vocab.is_empty():
+            return None  # accumulating into an existing vocab: python path
+        if not native_available():
+            return None  # before materializing the joined corpus for nothing
+        try:
+            text = "\n".join(
+                s.replace("\n", " ") for s in self.sentence_iterator
+            ).encode("utf-8", errors="strict")
+        except UnicodeEncodeError:
+            return None
+        out = corpus_index(text, self.min_word_frequency)
+        if out is None:
+            return None
+        words, counts, flat, sids = out
+        return words, counts, flat, sids
 
     @staticmethod
     def _digest(arrays) -> tuple:

@@ -49,6 +49,26 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
     ]
     lib.dl4j_loader_close.argtypes = [ctypes.c_void_p]
+    lib.dl4j_corpus_index.restype = ctypes.c_void_p
+    lib.dl4j_corpus_index.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int,
+    ]
+    lib.dl4j_corpus_vocab_size.restype = ctypes.c_int64
+    lib.dl4j_corpus_vocab_size.argtypes = [ctypes.c_void_p]
+    lib.dl4j_corpus_words_bytes.restype = ctypes.c_int64
+    lib.dl4j_corpus_words_bytes.argtypes = [ctypes.c_void_p]
+    lib.dl4j_corpus_export_vocab.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.dl4j_corpus_n_tokens.restype = ctypes.c_int64
+    lib.dl4j_corpus_n_tokens.argtypes = [ctypes.c_void_p]
+    lib.dl4j_corpus_n_sentences.restype = ctypes.c_int64
+    lib.dl4j_corpus_n_sentences.argtypes = [ctypes.c_void_p]
+    lib.dl4j_corpus_export_index.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.dl4j_corpus_free.argtypes = [ctypes.c_void_p]
     return lib
 
 
@@ -101,6 +121,46 @@ def load_csv(path: str, delimiter: str = ",", skip_lines: int = 0) -> np.ndarray
     finally:
         lib.dl4j_free(ptr)
     return arr.reshape(rows.value, cols.value)
+
+
+def corpus_index(text: bytes, min_count: int = 1
+                 ) -> Optional[Tuple[list, np.ndarray, np.ndarray, np.ndarray]]:
+    """Native corpus tokenize+count+index (native/text.cpp).
+
+    ``text``: newline-separated ASCII sentences. Returns
+    (words, counts int64, flat int32, sentence_ids int32) with the exact
+    semantics of VocabCache.finish + word2vec build_vocab indexing
+    (vocab by (-count, word); sentences with <2 kept tokens dropped),
+    or None when the native library is unavailable or the input is not
+    ASCII (byte-wise tokenizing would diverge from Python str.split on
+    unicode whitespace — the caller keeps its Python path)."""
+    lib = _get_lib()
+    if lib is None or not text.isascii():
+        return None
+    handle = lib.dl4j_corpus_index(text, len(text), min_count)
+    if not handle:
+        return None
+    try:
+        n_vocab = lib.dl4j_corpus_vocab_size(handle)
+        counts = np.zeros(n_vocab, np.int64)
+        words_buf = ctypes.create_string_buffer(
+            int(lib.dl4j_corpus_words_bytes(handle)))
+        if n_vocab:
+            lib.dl4j_corpus_export_vocab(
+                handle, words_buf,
+                counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        words = words_buf.raw.decode("ascii").split("\n")[:-1] if n_vocab else []
+        n_tok = lib.dl4j_corpus_n_tokens(handle)
+        flat = np.zeros(n_tok, np.int32)
+        sids = np.zeros(n_tok, np.int32)
+        if n_tok:
+            lib.dl4j_corpus_export_index(
+                handle,
+                flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                sids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        return words, counts, flat, sids
+    finally:
+        lib.dl4j_corpus_free(handle)
 
 
 class PooledBuffer:

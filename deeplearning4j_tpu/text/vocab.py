@@ -56,6 +56,10 @@ class VocabCache:
     def contains(self, word: str) -> bool:
         return word in self._words
 
+    def is_empty(self) -> bool:
+        """True when no tokens have been added (finished or not)."""
+        return not self._words and not self._index
+
     def word_for(self, word: str) -> Optional[VocabWord]:
         return self._words.get(word)
 

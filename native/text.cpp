@@ -1,0 +1,145 @@
+// Native text pipeline: corpus tokenize + vocab count + index in C++.
+//
+// TPU-native analogue of the reference's host-side NLP hot path (vocab
+// build + sentence indexing feeding Word2Vec training,
+// ref: models/word2vec/Word2Vec.java fit() vocab phase + VocabActor /
+// wordstore InMemoryLookupCache): the host tokenization/counting work the
+// reference spreads across a JVM actor pool runs here as two tight passes
+// over one contiguous buffer.
+//
+// Contract (mirrors deeplearning4j_tpu/text/vocab.py exactly, for ASCII
+// input — the Python binding gates on bytes.isascii() so byte-wise
+// tokenizing and sorting coincide with Python str semantics):
+//   - sentences separated by '\n'; tokens split on ASCII whitespace
+//   - vocab = words with count >= min_count, ordered by (-count, word)
+//   - corpus index = per-sentence vocab hits; sentences with < 2 kept
+//     tokens are dropped (word2vec.py build_vocab)
+//
+// Exported with the same C ABI / error-reporting pattern as dataloader.cpp.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+inline bool is_ws(unsigned char c) {
+  // exactly the ASCII chars Python str.split() treats as whitespace:
+  // \t \v \f \r space and the \x1c-\x1f separator controls ('\n' is the
+  // sentence delimiter, handled by scan())
+  return c == ' ' || c == '\t' || c == '\r' || c == '\v' || c == '\f' ||
+         (c >= 0x1c && c <= 0x1f);
+}
+
+struct Corpus {
+  // vocab, sorted by (-count, word)
+  std::vector<std::string> words;
+  std::vector<int64_t> counts;
+  // '\n'-joined byte length of words (for export sizing)
+  int64_t words_bytes = 0;
+  // corpus index
+  std::vector<int32_t> flat;
+  std::vector<int32_t> sids;
+};
+
+// Walk [buf, buf+len) calling sent_end() at each '\n' (and once at EOF)
+// and tok(tokens_view) per whitespace-delimited token.
+template <typename TokFn, typename SentFn>
+void scan(const char *buf, int64_t len, TokFn &&tok, SentFn &&sent_end) {
+  int64_t i = 0;
+  while (i <= len) {
+    int64_t start = i;
+    while (i < len && !is_ws(buf[i]) && buf[i] != '\n') i++;
+    if (i > start) tok(std::string_view(buf + start, size_t(i - start)));
+    if (i >= len) {
+      sent_end();
+      break;
+    }
+    if (buf[i] == '\n') sent_end();
+    i++;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+Corpus *dl4j_corpus_index(const char *buf, int64_t len, int min_count) {
+  if (buf == nullptr || len < 0) return nullptr;  // caller falls back
+  auto *c = new Corpus();
+  // pass 1: count tokens
+  std::unordered_map<std::string_view, int64_t> count;
+  count.reserve(1 << 16);
+  scan(buf, len, [&](std::string_view t) { count[t]++; }, [] {});
+  // vocab: prune + sort by (-count, word) — identical to VocabCache.finish
+  std::vector<std::pair<std::string_view, int64_t>> kept;
+  kept.reserve(count.size());
+  for (auto &kv : count)
+    if (kv.second >= min_count) kept.emplace_back(kv.first, kv.second);
+  std::sort(kept.begin(), kept.end(), [](const auto &a, const auto &b) {
+    if (a.second != b.second) return a.second > b.second;
+    return a.first < b.first;
+  });
+  std::unordered_map<std::string_view, int32_t> index;
+  index.reserve(kept.size());
+  c->words.reserve(kept.size());
+  c->counts.reserve(kept.size());
+  for (size_t i = 0; i < kept.size(); i++) {
+    index.emplace(kept[i].first, int32_t(i));
+    c->words.emplace_back(kept[i].first);
+    c->counts.push_back(kept[i].second);
+    c->words_bytes += int64_t(kept[i].first.size()) + 1;  // + '\n'
+  }
+  // pass 2: index sentences (>= 2 kept tokens, as word2vec.py build_vocab)
+  std::vector<int32_t> sent;
+  int32_t sid = 0;
+  scan(
+      buf, len,
+      [&](std::string_view t) {
+        auto it = index.find(t);
+        if (it != index.end()) sent.push_back(it->second);
+      },
+      [&] {
+        if (sent.size() >= 2) {
+          c->flat.insert(c->flat.end(), sent.begin(), sent.end());
+          c->sids.insert(c->sids.end(), sent.size(), sid);
+          sid++;
+        }
+        sent.clear();
+      });
+  return c;
+}
+
+int64_t dl4j_corpus_vocab_size(Corpus *c) { return int64_t(c->words.size()); }
+
+int64_t dl4j_corpus_words_bytes(Corpus *c) { return c->words_bytes; }
+
+// words_out: words_bytes chars, '\n' after every word; counts_out: vocab_size
+void dl4j_corpus_export_vocab(Corpus *c, char *words_out, int64_t *counts_out) {
+  char *p = words_out;
+  for (size_t i = 0; i < c->words.size(); i++) {
+    std::memcpy(p, c->words[i].data(), c->words[i].size());
+    p += c->words[i].size();
+    *p++ = '\n';
+    counts_out[i] = c->counts[i];
+  }
+}
+
+int64_t dl4j_corpus_n_tokens(Corpus *c) { return int64_t(c->flat.size()); }
+
+int64_t dl4j_corpus_n_sentences(Corpus *c) {
+  return c->sids.empty() ? 0 : int64_t(c->sids.back()) + 1;
+}
+
+void dl4j_corpus_export_index(Corpus *c, int32_t *flat, int32_t *sids) {
+  std::memcpy(flat, c->flat.data(), c->flat.size() * sizeof(int32_t));
+  std::memcpy(sids, c->sids.data(), c->sids.size() * sizeof(int32_t));
+}
+
+void dl4j_corpus_free(Corpus *c) { delete c; }
+
+}  // extern "C"

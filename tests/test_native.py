@@ -201,3 +201,106 @@ class TestNativeCSVDataSetIterator:
         preds = net.predict(x.astype(np.float32))
         assert (preds == y).mean() > 0.9
         it.close()
+
+
+class TestNativeCorpusIndex:
+    """native/text.cpp tokenize+count+index vs the Python path
+    (ref host hot path: Word2Vec.java vocab phase + VocabActor)."""
+
+    CORPUS = [
+        "the quick brown fox jumps over the lazy dog",
+        "the dog barks",
+        "lonely",          # 1 kept token -> dropped from the index
+        "quick quick fox the",
+        "zebra apple apple the",
+    ]
+
+    def _python_reference(self, sentences, min_count):
+        from deeplearning4j_tpu.models.word2vec import Word2Vec
+        from deeplearning4j_tpu.text.sentence_iterator import (
+            CollectionSentenceIterator,
+        )
+
+        w = Word2Vec(
+            sentence_iterator=CollectionSentenceIterator(sentences),
+            layer_size=8, min_word_frequency=min_count, seed=1,
+        )
+        # force the python path regardless of library availability
+        w._native_vocab_index = lambda: None
+        w.build_vocab()
+        return w
+
+    def test_parity_with_python_path(self):
+        import pytest as _pytest
+
+        from deeplearning4j_tpu.native.lib import corpus_index, native_available
+
+        if not native_available():
+            _pytest.skip("native library unavailable")
+        for min_count in (1, 2):
+            ref = self._python_reference(self.CORPUS, min_count)
+            text = "\n".join(self.CORPUS).encode()
+            words, counts, flat, sids = corpus_index(text, min_count)
+            ref_words = [vw.word for vw in ref.vocab.words()]
+            ref_counts = [vw.count for vw in ref.vocab.words()]
+            assert words == ref_words, (min_count, words, ref_words)
+            assert counts.tolist() == ref_counts
+            np.testing.assert_array_equal(flat, ref._flat)
+            np.testing.assert_array_equal(sids, ref._sid)
+
+    def test_word2vec_uses_native_path_equivalently(self):
+        import pytest as _pytest
+
+        from deeplearning4j_tpu.models.word2vec import Word2Vec
+        from deeplearning4j_tpu.native.lib import native_available
+        from deeplearning4j_tpu.text.sentence_iterator import (
+            CollectionSentenceIterator,
+        )
+
+        if not native_available():
+            _pytest.skip("native library unavailable")
+        ref = self._python_reference(self.CORPUS, 1)
+        nat = Word2Vec(
+            sentence_iterator=CollectionSentenceIterator(self.CORPUS),
+            layer_size=8, min_word_frequency=1, seed=1,
+        )
+        nat.build_vocab()
+        assert [w.word for w in nat.vocab.words()] == [
+            w.word for w in ref.vocab.words()]
+        np.testing.assert_array_equal(nat._flat, ref._flat)
+        np.testing.assert_array_equal(nat._sid, ref._sid)
+        # huffman codes identical too (same counts -> same tree)
+        for a, b in zip(nat.vocab.words(), ref.vocab.words()):
+            assert a.code == b.code and a.points == b.points
+
+    def test_non_ascii_falls_back(self):
+        from deeplearning4j_tpu.models.word2vec import Word2Vec
+        from deeplearning4j_tpu.text.sentence_iterator import (
+            CollectionSentenceIterator,
+        )
+
+        sents = ["café au lait", "café noir s'il vous plaît"]
+        w = Word2Vec(sentence_iterator=CollectionSentenceIterator(sents),
+                     layer_size=8, seed=1)
+        assert w._native_vocab_index() is None  # unicode -> python path
+        w.build_vocab()  # iterator re-iterates fine after the probe
+        assert w.vocab.contains("café")
+
+    def test_preprocessor_falls_back(self):
+        from deeplearning4j_tpu.models.word2vec import Word2Vec
+        from deeplearning4j_tpu.text.sentence_iterator import (
+            CollectionSentenceIterator,
+        )
+        from deeplearning4j_tpu.text.tokenization import (
+            CommonPreprocessor,
+            DefaultTokenizerFactory,
+        )
+
+        w = Word2Vec(
+            sentence_iterator=CollectionSentenceIterator(["The DOG. barks!"]),
+            tokenizer_factory=DefaultTokenizerFactory(CommonPreprocessor()),
+            layer_size=8, seed=1,
+        )
+        assert w._native_vocab_index() is None
+        w.build_vocab()
+        assert w.vocab.contains("dog")  # lowercased + punctuation stripped

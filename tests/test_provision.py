@@ -1,6 +1,9 @@
 """Provisioning tests (ref: aws/ec2/provision/ — Ec2BoxCreator,
 HostProvisioner, ClusterSetup). Commands are asserted through a recording
-runner; nothing touches a real cloud."""
+runner; nothing touches a real cloud — except the launch-wiring test, which
+drives the emitted env through two real local processes."""
+
+import pytest
 
 from deeplearning4j_tpu.scaleout.provision import (
     ClusterSetup,
@@ -159,14 +162,11 @@ assert np.isfinite(s), s
 print(f"TRAINOK {pid} {s:.6f}", flush=True)
 """
 
+    @pytest.mark.slow
     def test_emitted_env_wiring_trains_across_two_processes(self, tmp_path):
         import os
         import subprocess
         import sys
-
-        import pytest
-
-        pytest.importorskip("jax")
         script = tmp_path / "train_child.py"
         script.write_text(self.CHILD)
 
