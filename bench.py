@@ -90,17 +90,28 @@ LSTM_SEQ = 64
 LSTM_FWD_FLOPS = LSTM_SEQ * 2 * (
     (1 + LSTM_VOCAB + LSTM_VOCAB) * 4 * LSTM_VOCAB + LSTM_VOCAB * LSTM_VOCAB
 )
+# causal attention char-LM (models/zoo.py char_attention_lm): per sample the
+# embedding + qkv/out projections + decoder (matmul term) and the T^2 d
+# score/value einsums (attention term).
+ATTN_VOCAB, ATTN_D, ATTN_SEQ = 128, 256, 64
+ATTN_FWD_FLOPS = (
+    2 * ATTN_SEQ * (2 * ATTN_VOCAB * ATTN_D + 4 * ATTN_D * ATTN_D)
+    + 4 * ATTN_SEQ * ATTN_SEQ * ATTN_D
+)
 TRAIN_FLOPS = {
     "mlp": 3 * MLP_FWD_FLOPS,
     "lenet": 3 * LENET_FWD_FLOPS,
     "conv": 3 * CONV_WIDE_FWD_FLOPS,   # stage "conv_wide_*" → model "conv"
     "lstm": 3 * LSTM_FWD_FLOPS,
+    "attn": 3 * ATTN_FWD_FLOPS,
 }
 
 # Per-model batch/chunk: the wide conv's im2col buffers and the LSTM's
 # one-hot sequences are far bigger per sample than the MLP's 784 floats.
-MODEL_BATCH = {"mlp": BATCH, "lenet": BATCH, "conv": 64, "lstm": 256}
-MODEL_CHUNK = {"mlp": CHUNK, "lenet": CHUNK, "conv": 32, "lstm": 16}
+MODEL_BATCH = {"mlp": BATCH, "lenet": BATCH, "conv": 64, "lstm": 256,
+               "attn": 256}
+MODEL_CHUNK = {"mlp": CHUNK, "lenet": CHUNK, "conv": 32, "lstm": 16,
+               "attn": 16}
 
 
 def _time_of(fn) -> float:
@@ -110,7 +121,13 @@ def _time_of(fn) -> float:
 
 
 def _conf(model: str):
-    from deeplearning4j_tpu.models.zoo import char_lstm, conv_wide, lenet, mnist_mlp
+    from deeplearning4j_tpu.models.zoo import (
+        char_attention_lm,
+        char_lstm,
+        conv_wide,
+        lenet,
+        mnist_mlp,
+    )
 
     if model == "mlp":
         return mnist_mlp(HID1, HID2)
@@ -120,6 +137,9 @@ def _conf(model: str):
         return conv_wide()
     if model == "lstm":
         return char_lstm(vocab=LSTM_VOCAB)
+    if model == "attn":
+        return char_attention_lm(vocab=ATTN_VOCAB, d_model=ATTN_D,
+                                 n_heads=8, num_iterations=1)
     raise ValueError(model)
 
 
@@ -152,6 +172,13 @@ def _make_data(model: str, chunk: int, batch: int):
         )
         xs = jax.nn.one_hot(toks[..., :-1], LSTM_VOCAB, dtype=jnp.float32)
         ys = jax.nn.one_hot(toks[..., 1:], LSTM_VOCAB, dtype=jnp.float32)
+        return xs, ys
+    if model == "attn":
+        toks = jax.random.randint(
+            jax.random.PRNGKey(2), (chunk, batch, ATTN_SEQ + 1), 0, ATTN_VOCAB
+        )
+        xs = jax.nn.one_hot(toks[..., :-1], ATTN_VOCAB, dtype=jnp.float32)
+        ys = jax.nn.one_hot(toks[..., 1:], ATTN_VOCAB, dtype=jnp.float32)
         return xs, ys
     raise ValueError(model)
 
@@ -322,6 +349,7 @@ STAGES = [
     ("conv_wide_bf16", 170),
     ("lstm_bf16", 170),
     ("lstm_fp32", 130),
+    ("attn_bf16", 170),
     ("cpu_word2vec", 150),
     ("word2vec", 120),
 ]

@@ -157,6 +157,28 @@ def stacked_denoising_autoencoder(
     return b.pretrain(True).backward(True).build()
 
 
+def char_attention_lm(vocab: int = 64, d_model: int = 64, n_heads: int = 4,
+                      seed: int = 42, lr: float = 0.1,
+                      num_iterations: int = 50) -> MultiLayerConfiguration:
+    """Causal attention char-LM (beyond-reference long-context model):
+    DENSE embedding projection vocab→d_model, then a causal multi-head
+    self-attention block whose decoder emits per-timestep vocab logits
+    (same sequence-head contract as char_lstm). The attention core is the
+    ring-attention math, so the same conf trains sequence-parallel via
+    nn/layers/attention.forward_ring."""
+    return (
+        NeuralNetConfiguration.Builder()
+        .lr(lr).seed(seed).activation_function("linear")
+        .loss_function("MCXENT").num_iterations(num_iterations)
+        .list(2)
+        .override(0, layer_type="DENSE", n_in=vocab, n_out=d_model)
+        .override(1, layer_type="ATTENTION", n_in=d_model, n_out=vocab,
+                  n_heads=n_heads, causal=True)
+        .pretrain(False).backward(True)
+        .build()
+    )
+
+
 def char_lstm(vocab: int = 64, seed: int = 42,
               lr: float = 0.1) -> MultiLayerConfiguration:
     """Karpathy-style char LSTM (ref: nn/layers/recurrent/LSTM.java).

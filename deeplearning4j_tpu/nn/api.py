@@ -37,6 +37,7 @@ class LayerType(str, enum.Enum):
     CONVOLUTION = "CONVOLUTION"
     SUBSAMPLING = "SUBSAMPLING"
     LSTM = "LSTM"
+    ATTENTION = "ATTENTION"
 
     @classmethod
     def coerce(cls, v) -> "LayerType":

@@ -82,6 +82,9 @@ class NeuralNetConfiguration:
     stride: Tuple[int, ...] = (2, 2)
     feature_map_size: Tuple[int, ...] = (9, 9)
     convolution_type: ConvolutionType = ConvolutionType.MAX
+    # attention (beyond-reference long-context layer)
+    n_heads: int = 1
+    causal: bool = True
     # batching
     batch_size: int = 10
 

@@ -136,7 +136,8 @@ def network_per_example_loss(
         per = output_layer.output_per_example_loss(
             head, params[n - 1], x, labels, train=train,
             key=keys[n - 1], drop_connect=conf.use_drop_connect)
-    elif head.layer_type == LayerType.LSTM:
+    elif head.layer_type in (LayerType.LSTM, LayerType.ATTENTION):
+        # sequence heads own a decoder producing per-timestep logits
         logits = layer_ops.forward(head, params[n - 1], x, train=train,
                                    key=keys[n - 1]).astype(jnp.float32)
         labels = labels.astype(jnp.float32)
@@ -147,8 +148,8 @@ def network_per_example_loss(
         else:
             per = per_example_loss(head.loss_function, labels, logits)
     else:
-        raise ValueError(
-            "network_per_example_loss requires an OUTPUT or LSTM head layer")
+        raise ValueError("network_per_example_loss requires an OUTPUT, "
+                         "LSTM, or ATTENTION head layer")
     if per.ndim > 1:  # sequence head: average the per-timestep losses
         per = jnp.mean(per, axis=tuple(range(1, per.ndim)))
     return per

@@ -15,6 +15,7 @@ import jax
 from deeplearning4j_tpu.nn.api import LayerType
 from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.layers import (
+    attention,
     autoencoder,
     convolution,
     dense,
@@ -34,6 +35,7 @@ _FORWARD = {
     LayerType.CONVOLUTION: convolution.forward,
     LayerType.SUBSAMPLING: subsampling.forward,
     LayerType.LSTM: lstm.forward,
+    LayerType.ATTENTION: attention.forward,
 }
 
 

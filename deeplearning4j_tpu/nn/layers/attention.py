@@ -1,0 +1,119 @@
+"""Multi-head self-attention block (beyond-reference long-context layer).
+
+The reference is pre-transformer (2015) and has no attention anywhere
+(SURVEY.md §2.5); this layer is the long-context counterpart to the scan
+LSTM and follows the same head contract (ref: nn/layers/recurrent/LSTM.java
+decoder + LSTMParamInitializer — the layer owns a decoder projection
+producing per-timestep logits, so it can be a sequence head under
+MultiLayerNetwork exactly like the LSTM).
+
+Block: pre-LayerNorm multi-head self-attention (causal by conf) with a
+residual connection, then the decoder projection n_in → n_out. All matmuls
+are (batch·time, d)-shaped MXU work; the attention core is the same dense
+einsum used by parallel/ring_attention.reference_attention, so the
+sequence-parallel path (``forward_ring``) computes the IDENTICAL function
+with the time axis sharded over a mesh axis and K/V rotating on ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.params import DECODER_BIAS_KEY, DECODER_WEIGHT_KEY
+
+Array = jax.Array
+
+LN_GAIN_KEY = "ln_g"
+LN_BIAS_KEY = "ln_b"
+Q_KEY, K_KEY, V_KEY, OUT_KEY = "wq", "wk", "wv", "wo"
+
+
+def _layernorm(x: Array, g: Array, b: Array) -> Array:
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-5) * g + b
+
+
+def _split_heads(x: Array, n_heads: int) -> Array:
+    """(B, T, D) → (B, H, T, D/H)."""
+    b, t, d = x.shape
+    return x.reshape(b, t, n_heads, d // n_heads).transpose(0, 2, 1, 3)
+
+
+def _merge_heads(x: Array) -> Array:
+    """(B, H, T, Hd) → (B, T, D)."""
+    b, h, t, hd = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, t, h * hd)
+
+
+def attend_block(conf: NeuralNetConfiguration, params: Dict[str, Array],
+                 x: Array, attn_core) -> Array:
+    """Pre-LN MHA + residual; ``attn_core(q, k, v) -> out`` supplies the
+    attention math ((B,H,T,Hd) in and out) so the dense and ring/Ulysses
+    paths share every projection."""
+    xn = _layernorm(x, params[LN_GAIN_KEY], params[LN_BIAS_KEY])
+    h = conf.n_heads
+    q = _split_heads(xn @ params[Q_KEY], h)
+    k = _split_heads(xn @ params[K_KEY], h)
+    v = _split_heads(xn @ params[V_KEY], h)
+    return x + _merge_heads(attn_core(q, k, v)) @ params[OUT_KEY]
+
+
+def _forward(conf: NeuralNetConfiguration, params: Dict[str, Array],
+             x: Array, attn_core) -> Array:
+    """Shared 2-D lift + block + decoder head for every attention path."""
+    if x.ndim == 2:
+        x = x[None]
+    hs = attend_block(conf, params, x, attn_core)
+    return hs @ params[DECODER_WEIGHT_KEY] + params[DECODER_BIAS_KEY]
+
+
+def hidden_sequence(conf: NeuralNetConfiguration, params: Dict[str, Array],
+                    x: Array) -> Array:
+    """The block output before the decoder: (batch, time, n_in)."""
+    from deeplearning4j_tpu.parallel.ring_attention import reference_attention
+
+    if x.ndim == 2:
+        x = x[None]
+    return attend_block(
+        conf, params, x,
+        lambda q, k, v: reference_attention(q, k, v, causal=conf.causal),
+    )
+
+
+def forward(
+    conf: NeuralNetConfiguration,
+    params: Dict[str, Array],
+    x: Array,
+    *,
+    train: bool = False,
+    key: Optional[Array] = None,
+) -> Array:
+    """Per-timestep logits: (batch, time, n_out)."""
+    from deeplearning4j_tpu.parallel.ring_attention import reference_attention
+
+    return _forward(
+        conf, params, x,
+        lambda q, k, v: reference_attention(q, k, v, causal=conf.causal),
+    )
+
+
+def forward_ring(conf: NeuralNetConfiguration, params: Dict[str, Array],
+                 x: Array, mesh: Mesh, axis: str) -> Array:
+    """The identical block with the SEQUENCE axis sharded over ``axis`` —
+    attention runs as ring attention (K/V blocks rotating via ppermute,
+    online-softmax accumulation, parallel/ring_attention.py) so per-device
+    memory is O(T/P). x: (batch, time, n_in) with time divisible by the
+    axis size; validated against ``forward`` in tests."""
+    from deeplearning4j_tpu.parallel.ring_attention import ring_attention
+
+    return _forward(
+        conf, params, x,
+        lambda q, k, v: ring_attention(q, k, v, mesh, axis,
+                                       causal=conf.causal),
+    )

@@ -68,7 +68,8 @@ def forward(
     # fused matmul+bias+activation kernel when enabled (see
     # pallas_kernels.use_fused_dense for the sharding rationale); the masked
     # (drop-connect) pre_output variant keeps the unfused route
-    if (not (drop_connect and train)
+    if (x.ndim == 2  # the fused kernel + its VJP are (batch, features) only
+            and not (drop_connect and train)
             and conf.activation_function in _FUSABLE
             and use_fused_dense()):
         return fused_dense(x, params[WEIGHT_KEY], params[BIAS_KEY],

@@ -82,6 +82,30 @@ def _lstm_params(key, conf: NeuralNetConfiguration) -> Dict[str, jax.Array]:
     }
 
 
+def _attention_params(key, conf: NeuralNetConfiguration) -> Dict[str, jax.Array]:
+    # Pre-LN multi-head self-attention block + decoder (beyond-reference —
+    # the 2015 codebase is pre-transformer; head contract mirrors the LSTM's
+    # decoder, nn/params/LSTMParamInitializer.java:39-41).
+    d = conf.n_in
+    if conf.n_heads < 1 or d % conf.n_heads != 0:
+        raise ValueError(
+            f"attention n_in ({d}) must be divisible by n_heads "
+            f"({conf.n_heads}); n_heads must be >= 1"
+        )
+    kq, kk, kv, ko, kd = jax.random.split(key, 5)
+    dd = (d, d)
+    return {
+        "ln_g": jnp.ones((d,)),
+        "ln_b": jnp.zeros((d,)),
+        "wq": init_weights(kq, dd, conf.weight_init, conf.dist),
+        "wk": init_weights(kk, dd, conf.weight_init, conf.dist),
+        "wv": init_weights(kv, dd, conf.weight_init, conf.dist),
+        "wo": init_weights(ko, dd, conf.weight_init, conf.dist),
+        DECODER_WEIGHT_KEY: init_weights(kd, (d, conf.n_out), conf.weight_init, conf.dist),
+        DECODER_BIAS_KEY: jnp.zeros((conf.n_out,)),
+    }
+
+
 def init_layer_params(key: jax.Array, conf: NeuralNetConfiguration) -> Dict[str, jax.Array]:
     """conf → named params; dispatch replaces ref LayerFactories.getFactory."""
     t = conf.layer_type
@@ -97,4 +121,6 @@ def init_layer_params(key: jax.Array, conf: NeuralNetConfiguration) -> Dict[str,
         return {}  # pooling has no params (ref: SubsampleParamInitializer)
     if t == LayerType.LSTM:
         return _lstm_params(key, conf)
+    if t == LayerType.ATTENTION:
+        return _attention_params(key, conf)
     raise ValueError(f"No param initializer for layer type {t}")
