@@ -73,17 +73,18 @@ def _forward(conf: NeuralNetConfiguration, params: Dict[str, Array],
     return hs @ params[DECODER_WEIGHT_KEY] + params[DECODER_BIAS_KEY]
 
 
+def _dense_core(conf):
+    from deeplearning4j_tpu.parallel.ring_attention import reference_attention
+
+    return lambda q, k, v: reference_attention(q, k, v, causal=conf.causal)
+
+
 def hidden_sequence(conf: NeuralNetConfiguration, params: Dict[str, Array],
                     x: Array) -> Array:
     """The block output before the decoder: (batch, time, n_in)."""
-    from deeplearning4j_tpu.parallel.ring_attention import reference_attention
-
     if x.ndim == 2:
         x = x[None]
-    return attend_block(
-        conf, params, x,
-        lambda q, k, v: reference_attention(q, k, v, causal=conf.causal),
-    )
+    return attend_block(conf, params, x, _dense_core(conf))
 
 
 def forward(
@@ -95,12 +96,7 @@ def forward(
     key: Optional[Array] = None,
 ) -> Array:
     """Per-timestep logits: (batch, time, n_out)."""
-    from deeplearning4j_tpu.parallel.ring_attention import reference_attention
-
-    return _forward(
-        conf, params, x,
-        lambda q, k, v: reference_attention(q, k, v, causal=conf.causal),
-    )
+    return _forward(conf, params, x, _dense_core(conf))
 
 
 def forward_ring(conf: NeuralNetConfiguration, params: Dict[str, Array],

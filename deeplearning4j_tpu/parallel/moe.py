@@ -1,0 +1,133 @@
+"""Expert parallelism: capacity-based top-1 mixture-of-experts dispatch.
+
+The reference has no MoE (SURVEY.md §2.5); this completes the framework's
+parallelism axes (dp/tp/sp/pp/ep). Each device on the "expert" mesh axis
+owns ONE expert's parameters. Dispatch is the TPU-shaped capacity design:
+
+  1. a shared router scores every token; top-1 assignment per token
+  2. each device gathers the first C tokens assigned to ITS expert
+     (C = capacity; overflow tokens are dropped, the standard trade that
+     keeps every shape static for XLA)
+  3. the expert computes on its (C, d) slice only — per-device FLOPs are
+     O(C), not O(N)
+  4. outputs scatter back to token positions scaled by the router
+     probability, and a psum over the expert axis combines the shards.
+     Dropped (overflow) tokens contribute EXACTLY ZERO rows — callers
+     embedding this in a block must add their own residual around it if
+     dropped tokens should keep their representation
+
+Everything is differentiable (gather/scatter/psum transpose cleanly), so
+``jax.grad`` trains router and experts together; parity and gradient tests
+pin the sharded dispatch against a dense single-device reference.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Array = jax.Array
+
+EXPERT_AXIS = "expert"
+
+
+def _dispatch_local(expert_params, router_w, x, capacity: int,
+                    axis_name: str, expert_fn: Callable):
+    """Per-device body under shard_map. x: (N, d) replicated tokens;
+    expert_params: this expert's params (stage axis stripped)."""
+    my = jax.lax.axis_index(axis_name)
+    n, d = x.shape
+
+    logits = x @ router_w  # (N, E) — router is replicated, computed locally
+    probs = jax.nn.softmax(logits, axis=-1)
+    assign = jnp.argmax(logits, axis=-1)  # (N,) top-1 expert id
+    gate = jnp.take_along_axis(probs, assign[:, None], axis=1)[:, 0]  # (N,)
+
+    mine = assign == my  # (N,)
+    # positions of the first `capacity` tokens routed here: rank tokens by
+    # (not-mine, position) so mine-in-order come first, then slice C
+    order = jnp.argsort(jnp.where(mine, jnp.arange(n), n + jnp.arange(n)))
+    slots = order[:capacity]  # (C,) token index per slot
+    slot_valid = mine[slots]  # overflow/empty slots are masked out
+
+    tokens = x[slots] * slot_valid[:, None]
+    y = expert_fn(expert_params, tokens)  # (C, d) — the O(C) expert compute
+    y = y * (gate[slots] * slot_valid)[:, None]
+
+    out = jnp.zeros((n, d), x.dtype).at[slots].add(y)
+    # combine expert shards; each token was computed on ≤1 device
+    return jax.lax.psum(out, axis_name)
+
+
+def moe_apply(router_w: Array, expert_params, x: Array, mesh: Mesh,
+              expert_fn: Callable, capacity: int,
+              axis: str = EXPERT_AXIS) -> Array:
+    """Top-1 MoE over experts sharded on ``axis``.
+
+    router_w: (d, E) replicated; expert_params: pytree with a leading
+    expert axis of size E (sharded onto ``axis``); x: (N, d).
+    Returns (N, d); tokens beyond an expert's capacity contribute zeros
+    (count them with expected_dropped for capacity tuning).
+    """
+    n_experts = mesh.shape[axis]
+    if router_w.shape[1] != n_experts:
+        raise ValueError(
+            f"router_w has {router_w.shape[1]} experts but mesh axis "
+            f"{axis!r} has {n_experts} devices — mismatched tokens would "
+            "silently drop")
+    for leaf in jax.tree_util.tree_leaves(expert_params):
+        if leaf.shape[0] != n_experts:
+            raise ValueError(
+                f"expert param leading dim {leaf.shape[0]} != mesh axis "
+                f"size {n_experts}")
+    param_spec = jax.tree_util.tree_map(lambda _: P(axis), expert_params)
+
+    def body(params, rw, xs):
+        local = jax.tree_util.tree_map(lambda a: a[0], params)
+        return _dispatch_local(local, rw, xs, capacity, axis, expert_fn)
+
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(param_spec, P(), P()), out_specs=P(),
+        check_vma=False,
+    )(expert_params, router_w, x)
+
+
+def expected_dropped(router_w: Array, x: Array, capacity: int) -> int:
+    """How many tokens overflow their expert's capacity for this batch."""
+    assign = jnp.argmax(x @ router_w, axis=-1)
+    n_experts = router_w.shape[1]
+    counts = jnp.bincount(assign, length=n_experts)
+    return int(jnp.sum(jnp.maximum(counts - capacity, 0)))
+
+
+def moe_reference(router_w: Array, expert_params_list, x: Array,
+                  expert_fn: Callable, capacity: int) -> Array:
+    """Dense single-device reference with IDENTICAL routing + capacity
+    semantics (for tests)."""
+    import numpy as np
+
+    logits = np.asarray(x @ router_w)
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+    assign = logits.argmax(-1)
+    out = np.zeros(np.asarray(x).shape, np.float32)
+    for e, params in enumerate(expert_params_list):
+        idx = np.nonzero(assign == e)[0][:capacity]
+        if idx.size == 0:
+            continue
+        y = np.asarray(expert_fn(params, jnp.asarray(np.asarray(x)[idx])))
+        out[idx] = y * probs[idx, e][:, None]
+    return jnp.asarray(out)
+
+
+def stack_expert_params(per_expert: list):
+    """[{k: array}, ...] → {k: (E, ...) array} for moe_apply."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_expert)
+
+
+def shard_expert_params(stacked, mesh: Mesh, axis: str = EXPERT_AXIS):
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, NamedSharding(mesh, P(axis))), stacked)

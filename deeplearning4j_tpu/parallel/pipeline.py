@@ -1,0 +1,140 @@
+"""Pipeline parallelism: GPipe-style microbatched stage pipeline.
+
+The reference has no pipeline parallelism (SURVEY.md §2.5: data parallelism
+is its only axis); this is a TPU-idiomatic extension completing the
+dp/tp/sp/pp axis set. Each device on the "pipe" mesh axis owns one STAGE
+(a contiguous group of identical layers); activations flow stage-to-stage
+via ``ppermute`` (ICI neighbor hops) while microbatches stream in, so at
+steady state every stage computes a different microbatch — the classic
+(M + S − 1)-tick schedule with S−1 bubble ticks.
+
+Scope: homogeneous stages (same activation shape in and out, e.g. a stack
+of d→d DENSE layers between an input projection and a head), which is the
+shape-uniformity pipelining itself requires. Differentiation works through
+the whole schedule (``ppermute`` transposes to the reverse permutation), so
+``jax.grad`` of a loss on the pipeline output yields exact gradients for
+every stage's parameters — validated against the sequential forward in
+tests.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Array = jax.Array
+
+PIPE_AXIS = "pipe"
+
+
+def _pipeline_body(stage_params, x_mbs, stage_fn, axis_name: str):
+    """Per-device schedule under shard_map.
+
+    stage_params: this stage's params (leading stage axis of size 1 removed
+    by the caller's specs — each leaf arrives as its own stage's slice).
+    x_mbs: (M, mb, d) microbatches, replicated (only stage 0 reads them).
+    Returns (M, mb, d): the pipeline output, replicated via psum (only the
+    last stage contributes non-zeros).
+    """
+    n_stages = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    n_micro, mb, d = x_mbs.shape
+    ticks = n_micro + n_stages - 1
+    fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        recv, outputs = carry
+        # stage 0 ingests microbatch t (clamped; masked when t >= M)
+        feed = jax.lax.dynamic_index_in_dim(
+            x_mbs, jnp.minimum(t, n_micro - 1), axis=0, keepdims=False)
+        x_in = jnp.where(my == 0, feed, recv)
+        y = stage_fn(stage_params, x_in)
+        # the last stage finishes microbatch (t − S + 1) at tick t
+        out_idx = t - (n_stages - 1)
+        write = (my == n_stages - 1) & (out_idx >= 0)
+        outputs = jax.lax.dynamic_update_index_in_dim(
+            outputs,
+            jnp.where(write, y, jax.lax.dynamic_index_in_dim(
+                outputs, jnp.maximum(out_idx, 0), axis=0, keepdims=False)),
+            jnp.maximum(out_idx, 0), axis=0)
+        # shift activations one stage forward (ring; stage 0's recv is unused)
+        recv_next = jax.lax.ppermute(y, axis_name, fwd)
+        return (recv_next, outputs), None
+
+    recv0 = jnp.zeros((mb, d), x_mbs.dtype)
+    out0 = jnp.zeros((n_micro, mb, d), x_mbs.dtype)
+    (_, outputs), _ = jax.lax.scan(tick, (recv0, out0), jnp.arange(ticks))
+    # replicate the last stage's outputs everywhere (other stages hold zeros)
+    mask = (my == n_stages - 1).astype(x_mbs.dtype)
+    return jax.lax.psum(outputs * mask, axis_name)
+
+
+def pipeline_apply(stage_params, x_mbs: Array, stage_fn: Callable,
+                   mesh: Mesh, axis: str = PIPE_AXIS) -> Array:
+    """Run microbatches through the stage pipeline.
+
+    stage_params: pytree whose leaves have a leading STAGE axis of size S
+    (sharded onto ``axis``); ``stage_fn(params_slice, x) -> y`` applies one
+    stage with that axis already stripped. x_mbs: (M, mb, d) microbatches.
+    Returns (M, mb, d) outputs, replicated.
+    """
+    n_stages = mesh.shape[axis]
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                f"stage param leading dim {leaf.shape[0]} != pipe axis size "
+                f"{n_stages} — a mismatch would silently run a different "
+                "(interleaved-stage) model")
+    param_spec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+
+    def body(params, x):
+        # strip the per-device stage axis (size 1 after sharding)
+        local = jax.tree_util.tree_map(lambda a: a[0], params)
+        return _pipeline_body(local, x, stage_fn, axis)
+
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(param_spec, P()), out_specs=P(),
+        check_vma=False,
+    )(stage_params, x_mbs)
+
+
+def stack_stage_params(per_stage: list):
+    """[{k: array}, ...] → {k: (S, ...) array} for pipeline_apply."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_stage)
+
+
+def shard_stage_params(stacked, mesh: Mesh, axis: str = PIPE_AXIS):
+    """Place stacked stage params with the stage axis on ``axis``."""
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, NamedSharding(mesh, P(axis))), stacked)
+
+
+def make_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
+                             mesh: Mesh, axis: str = PIPE_AXIS,
+                             lr: float = 0.1):
+    """SGD train step over the pipelined stack.
+
+    loss = mean over microbatches of ``loss_fn(y, labels_mb)`` on the
+    pipeline output; gradients flow back through the tick schedule (reverse
+    ppermute), so each stage's params receive exact gradients.
+    step(stacked_params, x_mbs, y_mbs) -> (new_params, loss).
+    """
+
+    def loss_of(params, x_mbs, y_mbs):
+        outs = pipeline_apply(params, x_mbs, stage_fn, mesh, axis)
+        per = jax.vmap(loss_fn)(outs, y_mbs)
+        return jnp.mean(per)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(params, x_mbs, y_mbs):
+        loss, grads = jax.value_and_grad(loss_of)(params, x_mbs, y_mbs)
+        new_params = jax.tree_util.tree_map(
+            lambda p, g: p - lr * g, params, grads)
+        return new_params, loss
+
+    return step

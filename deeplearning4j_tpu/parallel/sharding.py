@@ -44,6 +44,20 @@ def param_shardings(conf: MultiLayerConfiguration, mesh: Mesh) -> Tuple[dict, ..
                 shardings[WEIGHT_KEY] = NamedSharding(mesh, P(MODEL_AXIS, None))
                 shardings[BIAS_KEY] = NamedSharding(mesh, P())
                 col_parallel = True
+        elif has_tp and layer_conf.layer_type == LayerType.ATTENTION:
+            # Megatron MHA: qkv column-parallel (heads split across the model
+            # axis), output projection row-parallel — one all-reduce per
+            # block; decoder column-parallel when divisible. Heads must
+            # divide tp so no head straddles devices.
+            tp = mesh.shape[MODEL_AXIS]
+            if layer_conf.n_heads % tp == 0 and layer_conf.n_in % tp == 0:
+                col = NamedSharding(mesh, P(None, MODEL_AXIS))
+                for k in ("wq", "wk", "wv"):
+                    shardings[k] = col
+                shardings["wo"] = NamedSharding(mesh, P(MODEL_AXIS, None))
+                if layer_conf.n_out % tp == 0:
+                    from deeplearning4j_tpu.nn.params import DECODER_WEIGHT_KEY
+                    shardings[DECODER_WEIGHT_KEY] = col
         # everything not explicitly sharded is replicated
         out.append(shardings)
     return tuple(out)

@@ -1,0 +1,154 @@
+"""Expert-parallel MoE tests (beyond-reference axis — completes dp/tp/sp/pp/ep)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.parallel.moe import (
+    EXPERT_AXIS,
+    expected_dropped,
+    moe_apply,
+    moe_reference,
+    shard_expert_params,
+    stack_expert_params,
+)
+
+D = 8
+N_EXPERTS = 8
+N_TOKENS = 64
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:N_EXPERTS]), (EXPERT_AXIS,))
+
+
+def _expert_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _setup(seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), N_EXPERTS + 2)
+    per_expert = [
+        {"w": jax.random.normal(k, (D, D)) / np.sqrt(D), "b": jnp.zeros((D,))}
+        for k in ks[:N_EXPERTS]
+    ]
+    router_w = jax.random.normal(ks[-2], (D, N_EXPERTS)) / np.sqrt(D)
+    x = jax.random.normal(ks[-1], (N_TOKENS, D))
+    return router_w, per_expert, x
+
+
+def _dense_jax(router_w, stacked, x, capacity):
+    """Pure-JAX single-device replica of the sharded dispatch math (same
+    capacity/ordering semantics) — differentiable, for gradient parity."""
+    n = x.shape[0]
+    logits = x @ router_w
+    probs = jax.nn.softmax(logits, axis=-1)
+    assign = jnp.argmax(logits, axis=-1)
+    gate = jnp.take_along_axis(probs, assign[:, None], axis=1)[:, 0]
+    out = jnp.zeros_like(x)
+    for e in range(N_EXPERTS):
+        mine = assign == e
+        order = jnp.argsort(jnp.where(mine, jnp.arange(n), n + jnp.arange(n)))
+        slots = order[:capacity]
+        valid = mine[slots]
+        params_e = jax.tree_util.tree_map(lambda a: a[e], stacked)
+        y = _expert_fn(params_e, x[slots] * valid[:, None])
+        out = out.at[slots].add(y * (gate[slots] * valid)[:, None])
+    return out
+
+
+def test_moe_matches_dense_reference():
+    router_w, per_expert, x = _setup()
+    mesh = _mesh()
+    stacked = shard_expert_params(stack_expert_params(per_expert), mesh)
+    capacity = N_TOKENS  # ample: nothing dropped
+    out = moe_apply(router_w, stacked, x, mesh, _expert_fn, capacity)
+    ref = moe_reference(router_w, per_expert, x, _expert_fn, capacity)
+    assert jnp.allclose(out, ref, atol=1e-5), float(
+        jnp.max(jnp.abs(out - ref)))
+    assert expected_dropped(router_w, x, capacity) == 0
+
+
+def test_capacity_overflow_drops_tokens():
+    router_w, per_expert, x = _setup(1)
+    mesh = _mesh()
+    stacked = shard_expert_params(stack_expert_params(per_expert), mesh)
+    capacity = 4  # 64 tokens / 8 experts: busy experts must overflow
+    dropped = expected_dropped(router_w, x, capacity)
+    assert dropped > 0
+    out = moe_apply(router_w, stacked, x, mesh, _expert_fn, capacity)
+    ref = moe_reference(router_w, per_expert, x, _expert_fn, capacity)
+    assert jnp.allclose(out, ref, atol=1e-5)
+    # dropped tokens contribute exactly zero
+    n_zero_rows = int(jnp.sum(jnp.all(out == 0, axis=-1)))
+    assert n_zero_rows >= dropped
+
+
+def test_moe_gradients_match_dense():
+    """Gradients through the sharded dispatch (gather/scatter/psum) equal
+    the dense replica's for router AND expert params."""
+    router_w, per_expert, x = _setup(2)
+    mesh = _mesh()
+    stacked_sharded = shard_expert_params(stack_expert_params(per_expert), mesh)
+    stacked_local = stack_expert_params(per_expert)
+    tgt = jax.random.normal(jax.random.PRNGKey(9), (N_TOKENS, D))
+    capacity = 16
+
+    def sharded_loss(rw, params):
+        out = moe_apply(rw, params, x, mesh, _expert_fn, capacity)
+        return jnp.mean((out - tgt) ** 2)
+
+    def dense_loss(rw, params):
+        out = _dense_jax(rw, params, x, capacity)
+        return jnp.mean((out - tgt) ** 2)
+
+    gr_s, ge_s = jax.grad(sharded_loss, argnums=(0, 1))(router_w, stacked_sharded)
+    gr_d, ge_d = jax.grad(dense_loss, argnums=(0, 1))(router_w, stacked_local)
+    assert jnp.allclose(gr_s, gr_d, atol=1e-5), float(
+        jnp.max(jnp.abs(gr_s - gr_d)))
+    for k in ("w", "b"):
+        err = float(jnp.max(jnp.abs(jnp.asarray(ge_s[k]) - ge_d[k])))
+        assert err < 1e-5, (k, err)
+
+
+def test_moe_trains():
+    """Router + experts train jointly through the sharded dispatch (smoke:
+    loss strictly decreases; gradient EXACTNESS is pinned by
+    test_moe_gradients_match_dense)."""
+    router_w, per_expert, x = _setup(3)
+    mesh = _mesh()
+    params = shard_expert_params(stack_expert_params(per_expert), mesh)
+    tgt = jnp.tanh(jax.random.normal(jax.random.PRNGKey(11), (N_TOKENS, D)))
+    capacity = 16
+
+    # Warm the runtime with a forward-only dispatch first: on a single-core
+    # host, XLA CPU's 8-thread all-reduce rendezvous can spuriously hit its
+    # 40 s termination timeout when the very first collective program in
+    # the process is this fused fwd+bwd step (observed deterministic abort
+    # in rendezvous.cc; never once any collective has run first). Pure
+    # CPU-runtime scheduling quirk — TPU doesn't use CPU collectives.
+    jax.block_until_ready(
+        moe_apply(router_w, params, x, mesh, _expert_fn, capacity))
+
+    @jax.jit
+    def step(rw, ps):
+        def loss_fn(rw, ps):
+            out = moe_apply(rw, ps, x, mesh, _expert_fn, capacity)
+            return jnp.mean((out - tgt) ** 2)
+
+        loss, (gr, ge) = jax.value_and_grad(loss_fn, argnums=(0, 1))(rw, ps)
+        rw = rw - 1.0 * gr
+        ps = jax.tree_util.tree_map(lambda p, g: p - 1.0 * g, ps, ge)
+        return rw, ps, loss
+
+    _, _, first = step(router_w, params)
+    for _ in range(60):
+        router_w, params, loss = step(router_w, params)
+        # serialize dispatch: queuing 60 async multi-device executions on a
+        # single-core host can starve one rendezvous participant past XLA
+        # CPU's 40 s collective termination timeout (observed flaky abort)
+        jax.block_until_ready(loss)
+    # top-1 gating scales outputs by ~1/E at init, so MSE to an O(1) target
+    # moves slowly; assert a real monotone improvement, not a large one
+    assert float(loss) < float(first) * 0.99, (float(first), float(loss))

@@ -1,0 +1,114 @@
+"""Pipeline parallelism tests (beyond-reference axis — SURVEY.md §2.5: the
+reference's only axis is DP; pp completes dp/tp/sp/pp)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
+from deeplearning4j_tpu.parallel.pipeline import (
+    PIPE_AXIS,
+    make_pipeline_train_step,
+    pipeline_apply,
+    shard_stage_params,
+    stack_stage_params,
+)
+from jax.sharding import Mesh
+
+D = 16
+N_STAGES = 4
+N_MICRO = 8
+MB = 4
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:N_STAGES]), (PIPE_AXIS,))
+
+
+def _stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _stages(seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), N_STAGES)
+    return [
+        {"w": jax.random.normal(k, (D, D)) / np.sqrt(D),
+         "b": jnp.zeros((D,))}
+        for k in ks
+    ]
+
+
+def _sequential(per_stage, x):
+    for p in per_stage:
+        x = _stage_fn(p, x)
+    return x
+
+
+def test_pipeline_matches_sequential():
+    """The (M + S − 1)-tick ppermute schedule reproduces applying the four
+    stages in order to every microbatch."""
+    per_stage = _stages()
+    mesh = _mesh()
+    stacked = shard_stage_params(stack_stage_params(per_stage), mesh)
+    x = jax.random.normal(jax.random.PRNGKey(1), (N_MICRO, MB, D))
+    out = pipeline_apply(stacked, x, _stage_fn, mesh)
+    ref = jax.vmap(lambda m: _sequential(per_stage, m))(x)
+    assert jnp.allclose(out, ref, atol=1e-5), float(
+        jnp.max(jnp.abs(out - ref)))
+
+
+def test_pipeline_gradients_exact():
+    """jax.grad through the schedule (reverse ppermute) equals the
+    sequential model's gradients for EVERY stage's params."""
+    per_stage = _stages(3)
+    mesh = _mesh()
+    stacked = shard_stage_params(stack_stage_params(per_stage), mesh)
+    x = jax.random.normal(jax.random.PRNGKey(2), (N_MICRO, MB, D))
+    tgt = jax.random.normal(jax.random.PRNGKey(3), (N_MICRO, MB, D))
+
+    def pipe_loss(params):
+        out = pipeline_apply(params, x, _stage_fn, mesh)
+        return jnp.mean((out - tgt) ** 2)
+
+    def seq_loss(per_stage_list):
+        out = jax.vmap(lambda m: _sequential(per_stage_list, m))(x)
+        return jnp.mean((out - tgt) ** 2)
+
+    g_pipe = jax.grad(pipe_loss)(stacked)
+    g_seq = jax.grad(seq_loss)(per_stage)
+    for s in range(N_STAGES):
+        for k in ("w", "b"):
+            a = np.asarray(g_pipe[k][s])
+            b = np.asarray(g_seq[s][k])
+            err = float(np.max(np.abs(a - b)))
+            assert err < 1e-5, (s, k, err)
+
+
+def test_pipeline_training_reduces_loss():
+    per_stage = _stages(5)
+    mesh = _mesh()
+    params = shard_stage_params(stack_stage_params(per_stage), mesh)
+    x = jax.random.normal(jax.random.PRNGKey(4), (N_MICRO, MB, D))
+    tgt = jnp.tanh(jax.random.normal(jax.random.PRNGKey(5), (N_MICRO, MB, D)))
+
+    step = make_pipeline_train_step(
+        _stage_fn, lambda y, t: jnp.mean((y - t) ** 2), mesh, lr=0.2)
+    _, first = step(jax.tree_util.tree_map(jnp.array, params), x, tgt)
+    for _ in range(30):
+        params, loss = step(params, x, tgt)
+        # serialize dispatch: piled-up async multi-device executions can
+        # starve an XLA CPU collective rendezvous on a single-core host
+        jax.block_until_ready(loss)
+    assert float(loss) < float(first) * 0.7, (float(first), float(loss))
+
+
+def test_microbatch_count_not_divisible_by_stages():
+    """M and S need not be related: 6 microbatches over 4 stages."""
+    per_stage = _stages(7)
+    mesh = _mesh()
+    stacked = shard_stage_params(stack_stage_params(per_stage), mesh)
+    x = jax.random.normal(jax.random.PRNGKey(8), (6, MB, D))
+    out = pipeline_apply(stacked, x, _stage_fn, mesh)
+    ref = jax.vmap(lambda m: _sequential(per_stage, m))(x)
+    assert jnp.allclose(out, ref, atol=1e-5)
