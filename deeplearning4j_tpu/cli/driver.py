@@ -80,20 +80,33 @@ def train(args) -> int:
     net = _load_model(args.conf, None)
     it = _make_iterator(args.input, args.batch, args.labels,
                         args.features, args.label_index)
-    if args.runtime == "parallel":
-        # data-parallel over all visible devices (ref Train.execOnSpark
-        # dispatch → here the mesh trainer with in-graph averaging)
-        from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
-        from deeplearning4j_tpu.parallel.trainer import ParameterAveragingTrainer
+    import contextlib
 
-        trainer = ParameterAveragingTrainer(net, data_parallel_mesh())
-        for _ in range(args.epochs):
-            it.reset()
-            trainer.fit_data_set(it)
+    if getattr(args, "profile", None):
+        from deeplearning4j_tpu.utils.profiling import trace as _trace
+
+        profile_ctx = _trace(args.profile)
     else:
-        for _ in range(args.epochs):
-            it.reset()
-            net.fit(it)
+        profile_ctx = contextlib.nullcontext()
+    with profile_ctx:
+        if args.runtime == "parallel":
+            # data-parallel over all visible devices (ref Train.execOnSpark
+            # dispatch → here the mesh trainer with in-graph averaging)
+            from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
+            from deeplearning4j_tpu.parallel.trainer import (
+                ParameterAveragingTrainer,
+            )
+
+            trainer = ParameterAveragingTrainer(net, data_parallel_mesh())
+            for _ in range(args.epochs):
+                it.reset()
+                trainer.fit_data_set(it)
+        else:
+            for _ in range(args.epochs):
+                it.reset()
+                net.fit(it)
+    if getattr(args, "profile", None) and args.verbose:
+        print(f"wrote XLA trace to {args.profile}")
     _save_model(net, args.model)
     if args.verbose:
         print(f"saved params to {args.model}")
@@ -175,6 +188,9 @@ def build_parser() -> argparse.ArgumentParser:
     p_train = sub.add_parser("train", help="fit a model and save params")
     _add_common(p_train, needs_model_in=False)
     p_train.add_argument("--epochs", type=int, default=1)
+    p_train.add_argument("--profile", default=None, metavar="DIR",
+                         help="capture an XLA device trace of training "
+                              "into DIR (XProf/TensorBoard format)")
     p_train.add_argument("--runtime", choices=["local", "parallel"],
                          default="local",
                          help="'parallel' = data-parallel over all devices "

@@ -125,9 +125,13 @@ def moe_reference(router_w: Array, expert_params_list, x: Array,
 
 def stack_expert_params(per_expert: list):
     """[{k: array}, ...] → {k: (E, ...) array} for moe_apply."""
-    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_expert)
+    from deeplearning4j_tpu.parallel.sharding import stack_along_leading_axis
+
+    return stack_along_leading_axis(per_expert)
 
 
 def shard_expert_params(stacked, mesh: Mesh, axis: str = EXPERT_AXIS):
-    return jax.tree_util.tree_map(
-        lambda a: jax.device_put(a, NamedSharding(mesh, P(axis))), stacked)
+    """Place stacked expert params with the expert axis on ``axis``."""
+    from deeplearning4j_tpu.parallel.sharding import shard_leading_axis
+
+    return shard_leading_axis(stacked, mesh, axis)

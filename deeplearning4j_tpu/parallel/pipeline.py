@@ -105,13 +105,16 @@ def pipeline_apply(stage_params, x_mbs: Array, stage_fn: Callable,
 
 def stack_stage_params(per_stage: list):
     """[{k: array}, ...] → {k: (S, ...) array} for pipeline_apply."""
-    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_stage)
+    from deeplearning4j_tpu.parallel.sharding import stack_along_leading_axis
+
+    return stack_along_leading_axis(per_stage)
 
 
 def shard_stage_params(stacked, mesh: Mesh, axis: str = PIPE_AXIS):
     """Place stacked stage params with the stage axis on ``axis``."""
-    return jax.tree_util.tree_map(
-        lambda a: jax.device_put(a, NamedSharding(mesh, P(axis))), stacked)
+    from deeplearning4j_tpu.parallel.sharding import shard_leading_axis
+
+    return shard_leading_axis(stacked, mesh, axis)
 
 
 def make_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,

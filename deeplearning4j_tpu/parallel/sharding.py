@@ -63,6 +63,25 @@ def param_shardings(conf: MultiLayerConfiguration, mesh: Mesh) -> Tuple[dict, ..
     return tuple(out)
 
 
+def stack_along_leading_axis(per_item: list):
+    """[{k: array}, ...] → {k: (N, ...) array} — shared helper for the
+    stage-sharded (pipeline) and expert-sharded (moe) param layouts."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_item)
+
+
+def shard_leading_axis(stacked, mesh: Mesh, axis: str):
+    """Place every leaf's leading axis on the named mesh axis."""
+    import jax
+    from jax.sharding import PartitionSpec
+
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, NamedSharding(mesh, PartitionSpec(axis))),
+        stacked)
+
+
 def apply_shardings(params, shardings_per_layer, mesh: Mesh):
     """Place a params pytree according to param_shardings."""
     import jax

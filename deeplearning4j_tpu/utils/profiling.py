@@ -1,0 +1,92 @@
+"""Tracing/profiling utilities (SURVEY.md §5).
+
+The reference's tracing story is ad-hoc StopWatch timing in the YARN worker
+and per-job millisecond logging in the Akka WorkerActor heartbeat
+(ref: impl/multilayer/WorkerNode.java totalRunTimeWatch/batchWatch,
+actor/core/actor/WorkerActor.java:198-202). The TPU-native equivalent adds
+the XLA profiler on top of those counters (optimize/listeners.py,
+statetracker job_ms_total): device traces viewable in XProf/TensorBoard,
+scoped host annotations, and device-memory introspection.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Dict, List, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, *, create_perfetto_link: bool = False):
+    """Capture an XLA device+host trace for the enclosed block.
+
+    Produces an XProf/TensorBoard-compatible trace directory — the
+    device-side truth for where step time goes (MXU vs HBM vs infeed),
+    which host-side StopWatch timing (the reference's tool) cannot see.
+    """
+    os.makedirs(log_dir, exist_ok=True)
+    with jax.profiler.trace(log_dir,
+                            create_perfetto_link=create_perfetto_link):
+        yield
+
+
+def annotate(name: str):
+    """Scoped host annotation shown on the trace timeline
+    (e.g. ``with annotate("pretrain-layer0"): ...``)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def device_memory_stats() -> List[Dict]:
+    """Per-device live-memory stats (bytes in use / peak / limit where the
+    backend reports them). Empty dict per device on backends without
+    memory_stats (CPU)."""
+    out = []
+    for dev in jax.devices():
+        stats = {}
+        try:
+            stats = dict(dev.memory_stats() or {})
+        except Exception:
+            pass
+        out.append({"device": str(dev), **stats})
+    return out
+
+
+class ProfilerIterationListener:
+    """IterationListener that captures a trace of iterations
+    [start, start+steps) — drop it into net.listeners next to
+    ScoreIterationListener to profile a live training run
+    (the listener-chain hook mirrors ref: optimize/api/IterationListener)."""
+
+    def __init__(self, log_dir: str, start: int = 1, steps: int = 3):
+        self.log_dir = log_dir
+        self.start = start
+        self.steps = steps
+        self._active = False
+        self._seen = 0
+
+    def __call__(self, model, iteration: int, score: float) -> None:
+        self._seen += 1
+        if not self._active and self._seen == self.start:
+            os.makedirs(self.log_dir, exist_ok=True)
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+        elif self._active and self._seen >= self.start + self.steps:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def close(self) -> None:
+        """Stop a still-open trace (training ended inside the window)."""
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+
+def save_device_memory_profile(path: str) -> str:
+    """Dump a pprof-format device memory profile (jax.profiler
+    device_memory_profile) — allocation attribution for OOM hunts."""
+    blob = jax.profiler.device_memory_profile()
+    with open(path, "wb") as f:
+        f.write(blob)
+    return path

@@ -4,7 +4,7 @@ The BASELINE north star is "train to reference accuracy". These gates run on
 REAL data available offline: Fisher's Iris (embedded) and sklearn's bundled
 UCI digits scans. The full protocol (more epochs + SdA wall-clock + labeled
 synthetic-MNIST convergence proofs) lives in accuracy_gates.py and records
-ACCURACY_r02.json.
+ACCURACY_r04.json.
 """
 
 import pytest

@@ -1,0 +1,101 @@
+"""Tracing/profiling tests (SURVEY.md §5 — XLA-profiler upgrade over the
+reference's StopWatch/heartbeat-ms timing)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.utils.profiling import (
+    ProfilerIterationListener,
+    annotate,
+    device_memory_stats,
+    save_device_memory_profile,
+    trace,
+)
+
+
+def _dir_has_files(root):
+    for _, _, files in os.walk(root):
+        if files:
+            return True
+    return False
+
+
+def test_trace_context_writes_artifacts(tmp_path):
+    log_dir = str(tmp_path / "trace")
+    with trace(log_dir):
+        with annotate("test-block"):
+            jax.block_until_ready(jnp.ones((64, 64)) @ jnp.ones((64, 64)))
+    assert _dir_has_files(log_dir), "no trace artifacts written"
+
+
+def test_device_memory_stats_shape():
+    stats = device_memory_stats()
+    assert len(stats) == len(jax.devices())
+    assert all("device" in s for s in stats)
+
+
+def test_save_device_memory_profile(tmp_path):
+    path = save_device_memory_profile(str(tmp_path / "mem.pprof"))
+    assert os.path.getsize(path) > 0
+
+
+def test_profiler_iteration_listener(tmp_path):
+    log_dir = str(tmp_path / "iters")
+    listener = ProfilerIterationListener(log_dir, start=2, steps=2)
+    for i in range(6):
+        jax.block_until_ready(jnp.ones((16, 16)) @ jnp.ones((16, 16)))
+        listener(None, i, 0.0)
+    listener.close()
+    assert _dir_has_files(log_dir)
+
+
+def test_listener_in_real_training(tmp_path):
+    """The listener rides the MultiLayerNetwork listener chain during an
+    actual fit (ref: IterationListener hook)."""
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.Builder()
+            .n_in(4).n_out(8).activation_function("tanh").lr(0.1)
+            .num_iterations(5).seed(0).list(2)
+            .override(1, layer_type="OUTPUT", n_in=8, n_out=3,
+                      activation_function="softmax", loss_function="MCXENT")
+            .pretrain(False).backward(True).build())
+    net = MultiLayerNetwork(conf).init()
+    log_dir = str(tmp_path / "fit-trace")
+    listener = ProfilerIterationListener(log_dir, start=1, steps=2)
+    net.listeners.append(listener)
+    rng = np.random.RandomState(0)
+    net.fit(rng.rand(12, 4).astype(np.float32),
+            np.eye(3, dtype=np.float32)[rng.randint(0, 3, 12)])
+    listener.close()
+    assert _dir_has_files(log_dir)
+
+
+def test_cli_train_profile_flag(tmp_path):
+    """--profile DIR on the train subcommand captures a trace around fit."""
+    from deeplearning4j_tpu.cli.driver import main
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+
+    conf = (NeuralNetConfiguration.Builder()
+            .n_in(4).n_out(8).activation_function("tanh").lr(0.1)
+            .num_iterations(3).seed(0).list(2)
+            .override(1, layer_type="OUTPUT", n_in=8, n_out=3,
+                      activation_function="softmax", loss_function="MCXENT")
+            .pretrain(False).backward(True).build())
+    conf_path = tmp_path / "model.json"
+    conf_path.write_text(conf.to_json())
+    rng = np.random.RandomState(1)
+    rows = np.hstack([rng.rand(30, 4), rng.randint(0, 3, (30, 1))])
+    csv = tmp_path / "data.csv"
+    csv.write_text("\n".join(",".join(f"{v:.4f}" for v in r) for r in rows))
+    prof_dir = tmp_path / "prof"
+    rc = main(["train", "--conf", str(conf_path), "--input", str(csv),
+               "--model", str(tmp_path / "out.npz"), "--labels", "3",
+               "--profile", str(prof_dir)])
+    assert rc == 0
+    assert _dir_has_files(str(prof_dir))
+    assert (tmp_path / "out.npz").exists()
