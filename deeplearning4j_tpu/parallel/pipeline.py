@@ -117,6 +117,52 @@ def shard_stage_params(stacked, mesh: Mesh, axis: str = PIPE_AXIS):
     return shard_leading_axis(stacked, mesh, axis)
 
 
+def pipeline_from_conf(conf, params, mesh: Mesh, layers=None,
+                       axis: str = PIPE_AXIS):
+    """Stage a uniform DENSE segment of a MultiLayerConfiguration onto the
+    pipe mesh — the bridge from the framework's conf/param model to
+    pipeline_apply.
+
+    ``layers``: indices of the layers to stage (default: every layer whose
+    type is DENSE with n_in == n_out, matching the shape-uniformity
+    pipelining requires). All staged layers must share n_in/n_out/activation.
+    Returns (stacked_sharded_params, stage_fn) ready for pipeline_apply /
+    make_pipeline_train_step.
+    """
+    from deeplearning4j_tpu.nn.api import LayerType
+    from deeplearning4j_tpu.nn.layers import dense
+
+    if layers is None:
+        layers = [i for i in range(conf.n_layers)
+                  if conf.conf(i).layer_type == LayerType.DENSE
+                  and conf.conf(i).n_in == conf.conf(i).n_out]
+    if len(layers) != mesh.shape[axis]:
+        raise ValueError(
+            f"{len(layers)} uniform dense layers for a {mesh.shape[axis]}-"
+            f"device pipe axis — pass layers= explicitly to choose the "
+            "staged segment")
+    confs = [conf.conf(i) for i in layers]
+    for i, c in zip(layers, confs):
+        # explicit layers= must still be dense: anything else would silently
+        # run x@W+b in place of the layer's real forward
+        if c.layer_type != LayerType.DENSE:
+            raise ValueError(
+                f"layer {i} is {c.layer_type}, not DENSE — only uniform "
+                "dense segments can be pipelined through pipeline_from_conf")
+    c0 = confs[0]
+    for c in confs[1:]:
+        if (c.n_in, c.n_out, c.activation_function) != (
+                c0.n_in, c0.n_out, c0.activation_function):
+            raise ValueError("staged layers must be uniform "
+                             "(same n_in/n_out/activation)")
+
+    def stage_fn(p, x):
+        return dense.forward(c0, p, x)
+
+    stacked = stack_stage_params([params[i] for i in layers])
+    return shard_stage_params(stacked, mesh, axis), stage_fn
+
+
 def make_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
                              mesh: Mesh, axis: str = PIPE_AXIS,
                              lr: float = 0.1):

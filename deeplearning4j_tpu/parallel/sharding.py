@@ -75,11 +75,9 @@ def stack_along_leading_axis(per_item: list):
 def shard_leading_axis(stacked, mesh: Mesh, axis: str):
     """Place every leaf's leading axis on the named mesh axis."""
     import jax
-    from jax.sharding import PartitionSpec
 
     return jax.tree_util.tree_map(
-        lambda a: jax.device_put(a, NamedSharding(mesh, PartitionSpec(axis))),
-        stacked)
+        lambda a: jax.device_put(a, NamedSharding(mesh, P(axis))), stacked)
 
 
 def apply_shardings(params, shardings_per_layer, mesh: Mesh):

@@ -54,33 +54,47 @@ def device_memory_stats() -> List[Dict]:
 
 
 class ProfilerIterationListener:
-    """IterationListener that captures a trace of iterations
-    [start, start+steps) — drop it into net.listeners next to
-    ScoreIterationListener to profile a live training run
-    (the listener-chain hook mirrors ref: optimize/api/IterationListener)."""
+    """IterationListener that traces a window of a live training run — drop
+    it into net.listeners next to ScoreIterationListener (the listener-chain
+    hook mirrors ref: optimize/api/IterationListener).
+
+    Window semantics: listeners fire AFTER each iteration's compute, so the
+    trace opens once the ``start``-th callback has fired and spans the NEXT
+    ``steps`` iterations (callbacks start+1 … start+steps). The very first
+    iteration's compile can therefore not be captured through this hook —
+    wrap fit() in ``utils.profiling.trace`` for that. ``start=0`` opens the
+    window at the first callback."""
 
     def __init__(self, log_dir: str, start: int = 1, steps: int = 3):
         self.log_dir = log_dir
         self.start = start
         self.steps = steps
         self._active = False
+        self._done = False
         self._seen = 0
+        self._traced = 0
 
     def __call__(self, model, iteration: int, score: float) -> None:
         self._seen += 1
-        if not self._active and self._seen == self.start:
+        if self._active:
+            self._traced += 1
+            if self._traced >= self.steps:
+                jax.profiler.stop_trace()
+                self._active = False
+                self._done = True
+            return
+        if not self._done and self._seen >= self.start:
             os.makedirs(self.log_dir, exist_ok=True)
             jax.profiler.start_trace(self.log_dir)
             self._active = True
-        elif self._active and self._seen >= self.start + self.steps:
-            jax.profiler.stop_trace()
-            self._active = False
+            self._traced = 0
 
     def close(self) -> None:
         """Stop a still-open trace (training ended inside the window)."""
         if self._active:
             jax.profiler.stop_trace()
             self._active = False
+            self._done = True
 
 
 def save_device_memory_profile(path: str) -> str:

@@ -112,3 +112,53 @@ def test_microbatch_count_not_divisible_by_stages():
     out = pipeline_apply(stacked, x, _stage_fn, mesh)
     ref = jax.vmap(lambda m: _sequential(per_stage, m))(x)
     assert jnp.allclose(out, ref, atol=1e-5)
+
+
+def test_pipeline_from_conf_matches_network_forward():
+    """The conf/param bridge: a MultiLayerConfiguration's uniform DENSE
+    segment staged over the pipe mesh reproduces applying those layers
+    sequentially through the framework's own layer forward."""
+    from deeplearning4j_tpu.nn import functional as F
+    from deeplearning4j_tpu.nn import layers as layer_ops
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.parallel.pipeline import pipeline_from_conf
+
+    d = 16
+    conf = (NeuralNetConfiguration.Builder()
+            .n_in(d).n_out(d).activation_function("tanh").seed(3)
+            .weight_init("VI").list(5)
+            .override(4, layer_type="OUTPUT", n_in=d, n_out=3,
+                      activation_function="softmax", loss_function="MCXENT")
+            .pretrain(False).backward(True).build())
+    params = F.init_params(conf, jax.random.PRNGKey(0))
+    mesh = _mesh()  # 4 devices; layers 0-3 are the uniform dense segment
+
+    stacked, stage_fn = pipeline_from_conf(conf, params, mesh)
+    x = jax.random.normal(jax.random.PRNGKey(1), (N_MICRO, MB, d))
+    out = pipeline_apply(stacked, x, stage_fn, mesh)
+
+    def seq(m):
+        for i in range(4):
+            m = layer_ops.forward(conf.conf(i), params[i], m)
+        return m
+
+    ref = jax.vmap(seq)(x)
+    assert jnp.allclose(out, ref, atol=1e-5), float(
+        jnp.max(jnp.abs(out - ref)))
+
+
+def test_pipeline_from_conf_validates_stage_count():
+    import pytest as _pytest
+
+    from deeplearning4j_tpu.nn import functional as F
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.parallel.pipeline import pipeline_from_conf
+
+    conf = (NeuralNetConfiguration.Builder()
+            .n_in(8).n_out(8).activation_function("tanh").list(3)
+            .override(2, layer_type="OUTPUT", n_in=8, n_out=3,
+                      activation_function="softmax", loss_function="MCXENT")
+            .pretrain(False).backward(True).build())
+    params = F.init_params(conf, jax.random.PRNGKey(0))
+    with _pytest.raises(ValueError, match="pipe axis"):
+        pipeline_from_conf(conf, params, _mesh())  # 2 dense != 4 devices
