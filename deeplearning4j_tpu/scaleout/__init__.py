@@ -14,4 +14,7 @@ workers can drive either host-level fits or the collective trainer.
 
 from deeplearning4j_tpu.scaleout.job import Job  # noqa: F401
 from deeplearning4j_tpu.scaleout.statetracker import InMemoryStateTracker  # noqa: F401
-from deeplearning4j_tpu.scaleout.runner import LocalDistributedRunner  # noqa: F401
+from deeplearning4j_tpu.scaleout.runner import (  # noqa: F401
+    EarlyStopping,
+    LocalDistributedRunner,
+)

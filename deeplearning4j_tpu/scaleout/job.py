@@ -14,6 +14,10 @@ class Job:
         self.result: Any = None
         self.worker_id = worker_id
         self.pending = pending
+        #: training loss the performer observed for this job (None when the
+        #: performer doesn't report one) — feeds the master's bestLoss /
+        #: early-stop tracking (ref: StateTracker earlyStop/bestLoss)
+        self.score: Optional[float] = None
 
     def __repr__(self) -> str:
         return f"Job(worker_id={self.worker_id!r}, done={self.result is not None})"

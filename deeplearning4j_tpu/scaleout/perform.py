@@ -40,8 +40,15 @@ class MultiLayerNetworkWorkPerformer(WorkerPerformer):
         data = job.work
         if not isinstance(data, DataSet):
             raise TypeError(f"expected DataSet work, got {type(data)}")
+        # capture the last training-iteration loss through the listener
+        # chain — the master's bestLoss / early-stop signal (ref: tracker
+        # bestLoss updates) at zero extra compute (no post-fit forward)
+        last_score: list = []
+        net.listeners.append(
+            lambda _net, _it, s: last_score.append(float(s)))
         net.fit(data)
         job.result = np.asarray(net.params())
+        job.score = last_score[-1] if last_score else None
 
     def update(self, *args) -> None:
         """Receive the averaged master params (ref: performer.update)."""

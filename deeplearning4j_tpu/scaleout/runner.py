@@ -37,6 +37,24 @@ from deeplearning4j_tpu.scaleout.workrouter import IterativeReduceWorkRouter, Wo
 log = logging.getLogger(__name__)
 
 
+class EarlyStopping:
+    """Master-side early-stopping policy: stop distributing work after
+    ``patience`` aggregation rounds whose mean reported job loss fails to
+    improve the tracker's best loss by ``min_delta``.
+
+    The reference exposes earlyStop/bestLoss flags on the StateTracker
+    (StateTracker.java / BaseHazelCastStateTracker) but ships no policy
+    that trips them; here the master enforces them — and any external
+    caller can still trip ``tracker.early_stop()`` directly, which both
+    runner paths honor."""
+
+    def __init__(self, patience: int = 3, min_delta: float = 0.0):
+        if patience < 1:
+            raise ValueError("patience must be >= 1")
+        self.patience = patience
+        self.min_delta = min_delta
+
+
 class LocalDistributedRunner:
     def __init__(
         self,
@@ -50,6 +68,7 @@ class LocalDistributedRunner:
         fault_tolerant: bool = False,
         heartbeat_s: float = 0.002,
         async_timeout_s: Optional[float] = None,
+        early_stopping: Optional[EarlyStopping] = None,
     ):
         """performer_factory() -> WorkerPerformer (one per worker, mirroring
         WorkerPerformerFactory, ref: scaleout/perform/WorkerPerformerFactory)."""
@@ -70,6 +89,9 @@ class LocalDistributedRunner:
         #                                         the async path (None = run
         #                                         until the iterator drains,
         #                                         matching the sync path)
+        self.early_stopping = early_stopping
+        self._no_improve = 0  # evaluation rounds without best-loss progress
+        self._es_scores: list = []  # scores accumulated toward one round
         self._requeued: deque = deque()  # jobs orphaned by failed workers
         self._feed_lock = threading.Lock()  # guards iterator+requeued (async)
         self._async_jobs_left = 0  # set by _train_async (max_rounds bound)
@@ -109,6 +131,37 @@ class LocalDistributedRunner:
             return
         self._perform_and_publish(worker_id, job)
 
+    def _check_early_stopping(self) -> None:
+        """Update bestLoss from the pending updates' reported scores and trip
+        the tracker's early-stop flag after `patience` non-improving
+        evaluation rounds (ref: tracker earlyStop/bestLoss semantics).
+        Called just before each aggregation.
+
+        One evaluation round = at least `num_workers` accumulated scores:
+        the async master's heartbeat can tick with a single worker's update
+        pending, and judging patience on one noisy worker's loss while the
+        others are mid-job would trip spuriously; the sync barrier already
+        delivers exactly one score per worker per round."""
+        if self.early_stopping is None or self.tracker.is_early_stop():
+            return
+        self._es_scores.extend(
+            j.score for j in self.tracker.updates().values()
+            if j.score is not None)
+        if len(self._es_scores) < max(len(self.performers), 1):
+            return
+        loss = sum(self._es_scores) / len(self._es_scores)
+        self._es_scores.clear()
+        if loss < self.tracker.best_loss() - self.early_stopping.min_delta:
+            self.tracker.set_best_loss(loss)
+            self._no_improve = 0
+        else:
+            self._no_improve += 1
+            if self._no_improve >= self.early_stopping.patience:
+                log.info("early stopping: %d rounds without improvement",
+                         self._no_improve)
+                self.tracker.early_stop()
+                self.tracker.increment("early_stopped")
+
     def _handle_worker_failure(self, worker_id: str, exc: BaseException) -> None:
         """Dead-worker recovery (ref: MasterActor stale-job GC + tracker
         recentlyCleared re-route, MasterActor.java:115-142): the worker is
@@ -136,6 +189,9 @@ class LocalDistributedRunner:
         with ThreadPoolExecutor(max_workers=len(workers)) as pool:
             rounds = 0
             while rounds < self.max_rounds:
+                if self.tracker.is_early_stop():
+                    log.info("sync train: early-stop flag set — stopping")
+                    break
                 rounds += 1
                 # master: feed one job per IDLE worker — orphaned jobs from
                 # failed workers first, then fresh ones from the iterator
@@ -172,6 +228,7 @@ class LocalDistributedRunner:
                         ) from exc
                 # master: aggregate when router policy allows
                 if self.router.send_work():
+                    self._check_early_stopping()
                     self.router.update()
                     self.tracker.increment("aggregations")
                     if self.model_saver is not None:
@@ -210,7 +267,7 @@ class LocalDistributedRunner:
         """Continuous pull→perform→publish loop (ref: WorkerActor.java:168-206
         heartbeat, minus the barrier: the worker never waits for peers or for
         the master's aggregation)."""
-        while not stop.is_set():
+        while not stop.is_set() and not self.tracker.is_early_stop():
             self._replicate_if_needed(worker_id)
             job = self._next_job(worker_id)
             if job is None:
@@ -243,6 +300,7 @@ class LocalDistributedRunner:
                     time.sleep(self.heartbeat_s)
                     # master heartbeat: aggregate whatever has arrived
                     if self.router.send_work() and self.tracker.updates():
+                        self._check_early_stopping()
                         self.router.update()
                         self.tracker.increment("aggregations")
                         # save at most once per second (ref: MasterActor's
@@ -278,8 +336,10 @@ class LocalDistributedRunner:
             if failures and not self.performers:
                 raise RuntimeError("all workers failed")
             # drain jobs orphaned by failed workers on the survivors
-            # (repeat in case a survivor fails mid-drain)
-            while self._requeued:
+            # (repeat in case a survivor fails mid-drain); an early stop
+            # abandons orphans deliberately — the run is over, and drain
+            # workers would exit immediately anyway (hang otherwise)
+            while self._requeued and not self.tracker.is_early_stop():
                 if not self.performers:
                     raise RuntimeError("all workers failed")
                 stop2 = threading.Event()

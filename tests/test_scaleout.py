@@ -421,3 +421,143 @@ def test_two_workers_fail_same_round_no_job_lost():
     runner.train()
     assert runner.tracker.count("jobs_done") == 6
     assert runner.tracker.count("worker_failures") == 2
+
+
+class TestEarlyStopping:
+    """Master-side early stopping enforcing the tracker's earlyStop/bestLoss
+    flags (ref: StateTracker.java exposes the flags; here the master trips
+    and honors them)."""
+
+    def _stuck_runner(self, n_jobs=12, patience=2, router=None, tracker=None):
+        """Performer whose reported loss never improves."""
+        from deeplearning4j_tpu.scaleout import EarlyStopping
+        from deeplearning4j_tpu.scaleout.job import CollectionJobIterator
+        from deeplearning4j_tpu.scaleout.perform import WorkerPerformer
+
+        class StuckPerformer(WorkerPerformer):
+            def perform(self, job):
+                import time as _time
+
+                _time.sleep(0.005)  # give the master heartbeat ticks to
+                #                     observe several aggregation rounds
+                job.result = np.asarray([1.0])
+                job.score = 5.0  # constant: no improvement, ever
+
+            def update(self, *args):
+                pass
+
+        tracker = tracker or InMemoryStateTracker()
+        return LocalDistributedRunner(
+            performer_factory=StuckPerformer,
+            job_iterator=CollectionJobIterator(list(range(n_jobs))),
+            num_workers=2,
+            tracker=tracker,
+            router=router,
+            early_stopping=EarlyStopping(patience=patience),
+        )
+
+    def test_sync_stops_without_improvement(self):
+        runner = self._stuck_runner()
+        runner.train()
+        t = runner.tracker
+        assert t.is_early_stop()
+        assert t.count("early_stopped") == 1
+        # stopped well before the 12-job stream drained
+        assert t.count("jobs_done") < 12
+        assert t.best_loss() == 5.0  # first round set the best loss
+
+    def test_async_stops_without_improvement(self):
+        tracker = InMemoryStateTracker()
+        runner = self._stuck_runner(
+            n_jobs=200, patience=2, tracker=tracker,
+            router=HogWildWorkRouter(tracker, ParameterAveragingAggregator()))
+        runner.train()
+        assert tracker.is_early_stop()
+        assert tracker.count("jobs_done") < 200
+
+    def test_externally_set_flag_halts_sync_run(self):
+        runner = self._stuck_runner(patience=10_000)
+        runner.tracker.early_stop()  # e.g. an operator or another component
+        runner.train()
+        assert runner.tracker.count("jobs_done") == 0
+
+    def test_improving_run_does_not_stop(self):
+        from deeplearning4j_tpu.scaleout import EarlyStopping
+        from deeplearning4j_tpu.scaleout.job import CollectionJobIterator
+        from deeplearning4j_tpu.scaleout.perform import WorkerPerformer
+
+        class ImprovingPerformer(WorkerPerformer):
+            def __init__(self):
+                self.loss = 10.0
+
+            def perform(self, job):
+                job.result = np.asarray([1.0])
+                self.loss *= 0.9
+                job.score = self.loss
+
+            def update(self, *args):
+                pass
+
+        runner = LocalDistributedRunner(
+            performer_factory=ImprovingPerformer,
+            job_iterator=CollectionJobIterator(list(range(8))),
+            num_workers=2,
+            early_stopping=EarlyStopping(patience=2),
+        )
+        runner.train()
+        assert not runner.tracker.is_early_stop()
+        assert runner.tracker.count("jobs_done") == 8
+
+    def test_performer_reports_score(self):
+        from deeplearning4j_tpu.datasets.impl import IrisDataSetIterator
+        from deeplearning4j_tpu.scaleout.job import Job
+        from deeplearning4j_tpu.scaleout.perform import (
+            MultiLayerNetworkWorkPerformer,
+        )
+
+        performer = MultiLayerNetworkWorkPerformer(iris_conf_json(5))
+        job = Job(IrisDataSetIterator(30, 30).next(), "w0")
+        performer.perform(job)
+        assert job.score is not None and np.isfinite(job.score)
+
+    def test_async_early_stop_with_orphaned_job_does_not_hang(self):
+        """Regression: an early stop while a failed worker's job sits in the
+        requeue must not spin the drain loop forever (drain workers exit
+        immediately once the flag is set — orphans are abandoned)."""
+        import threading
+        import time as _time
+
+        from deeplearning4j_tpu.scaleout import EarlyStopping
+        from deeplearning4j_tpu.scaleout.job import CollectionJobIterator
+        from deeplearning4j_tpu.scaleout.perform import WorkerPerformer
+
+        class CrashOrStuck(WorkerPerformer):
+            def __init__(self, idx):
+                self.idx = idx
+
+            def perform(self, job):
+                if self.idx == 0:
+                    raise RuntimeError("boom")
+                _time.sleep(0.005)
+                job.result = np.asarray([1.0])
+                job.score = 5.0
+
+            def update(self, *args):
+                pass
+
+        counter = iter(range(10))
+        tracker = InMemoryStateTracker()
+        runner = LocalDistributedRunner(
+            performer_factory=lambda: CrashOrStuck(next(counter)),
+            job_iterator=CollectionJobIterator(list(range(50))),
+            num_workers=2,
+            tracker=tracker,
+            fault_tolerant=True,
+            router=HogWildWorkRouter(tracker, ParameterAveragingAggregator()),
+            early_stopping=EarlyStopping(patience=2),
+        )
+        t = threading.Thread(target=runner.train, daemon=True)
+        t.start()
+        t.join(60)
+        assert not t.is_alive(), "train() hung in the orphan drain loop"
+        assert tracker.is_early_stop()
