@@ -91,7 +91,7 @@ class LocalDistributedRunner:
         #                                         matching the sync path)
         self.early_stopping = early_stopping
         self._no_improve = 0  # evaluation rounds without best-loss progress
-        self._es_scores: list = []  # scores accumulated toward one round
+        self._es_scores: dict = {}  # worker_id -> latest score this round
         self._requeued: deque = deque()  # jobs orphaned by failed workers
         self._feed_lock = threading.Lock()  # guards iterator+requeued (async)
         self._async_jobs_left = 0  # set by _train_async (max_rounds bound)
@@ -131,25 +131,36 @@ class LocalDistributedRunner:
             return
         self._perform_and_publish(worker_id, job)
 
-    def _check_early_stopping(self) -> None:
-        """Update bestLoss from the pending updates' reported scores and trip
-        the tracker's early-stop flag after `patience` non-improving
-        evaluation rounds (ref: tracker earlyStop/bestLoss semantics).
-        Called just before each aggregation.
+    def _check_early_stopping(self, updates) -> None:
+        """Update bestLoss from the round's reported scores and trip the
+        tracker's early-stop flag after `patience` non-improving evaluation
+        rounds (ref: tracker earlyStop/bestLoss semantics). Called with the
+        SAME updates snapshot the aggregation consumes, so no score slips
+        between two separate tracker reads.
 
-        One evaluation round = at least `num_workers` accumulated scores:
-        the async master's heartbeat can tick with a single worker's update
-        pending, and judging patience on one noisy worker's loss while the
-        others are mid-job would trip spuriously; the sync barrier already
-        delivers exactly one score per worker per round."""
+        One evaluation round = a fresh score from EVERY live worker
+        (latest-wins per worker): the async master's heartbeat can tick
+        with only a fast worker's update pending, and judging patience on
+        that worker's noisy loss while a slower peer is mid-job (and
+        improving) would trip spuriously — including during startup, before
+        the slow worker's first job completes. Consequence: a worker whose
+        performer never reports scores (or that silently crashed and has
+        not yet been deregistered) disables the policy rather than letting
+        it trip on partial evidence; the external tracker.early_stop() flag
+        still halts everything immediately."""
         if self.early_stopping is None or self.tracker.is_early_stop():
             return
-        self._es_scores.extend(
-            j.score for j in self.tracker.updates().values()
-            if j.score is not None)
-        if len(self._es_scores) < max(len(self.performers), 1):
+        for worker_id, j in updates.items():
+            if j.score is not None:
+                self._es_scores[worker_id] = j.score
+        expected = set(self.performers)
+        # prune deregistered workers: a dead worker's stale score must not
+        # enter a later round's mean
+        self._es_scores = {w: s for w, s in self._es_scores.items()
+                           if w in expected}
+        if not expected or not expected.issubset(self._es_scores):
             return
-        loss = sum(self._es_scores) / len(self._es_scores)
+        loss = sum(self._es_scores.values()) / len(self._es_scores)
         self._es_scores.clear()
         if loss < self.tracker.best_loss() - self.early_stopping.min_delta:
             self.tracker.set_best_loss(loss)
@@ -226,10 +237,12 @@ class LocalDistributedRunner:
                         raise RuntimeError(
                             "all workers failed"
                         ) from exc
-                # master: aggregate when router policy allows
+                # master: aggregate when router policy allows (one snapshot
+                # feeds both the early-stop check and the aggregation)
                 if self.router.send_work():
-                    self._check_early_stopping()
-                    self.router.update()
+                    snapshot = self.tracker.updates()
+                    self._check_early_stopping(snapshot)
+                    self.router.update(snapshot)
                     self.tracker.increment("aggregations")
                     if self.model_saver is not None:
                         current = self.tracker.get_current()
@@ -298,10 +311,24 @@ class LocalDistributedRunner:
             try:
                 while any(not f.done() for f in futures.values()):
                     time.sleep(self.heartbeat_s)
-                    # master heartbeat: aggregate whatever has arrived
-                    if self.router.send_work() and self.tracker.updates():
-                        self._check_early_stopping()
-                        self.router.update()
+                    # deregister crashed workers NOW, not after the loop:
+                    # a dead worker left in self.performers would block the
+                    # early-stopping coverage rule for the whole run (ref
+                    # posture: MasterActor's heartbeat clears dead workers'
+                    # jobs continuously, MasterActor.java:115-142)
+                    for w, f in list(futures.items()):
+                        if f.done() and f.exception() is not None:
+                            if not self.fault_tolerant:
+                                raise f.exception()
+                            self._handle_worker_failure(w, f.exception())
+                            del futures[w]
+                    # master heartbeat: aggregate whatever has arrived (one
+                    # snapshot feeds the early-stop check AND the
+                    # aggregation, so no score slips between two reads)
+                    snapshot = self.tracker.updates()
+                    if self.router.send_work() and snapshot:
+                        self._check_early_stopping(snapshot)
+                        self.router.update(snapshot)
                         self.tracker.increment("aggregations")
                         # save at most once per second (ref: MasterActor's
                         # 1 s tick / ModelSavingActor per MoreWorkMessage) —
@@ -324,7 +351,7 @@ class LocalDistributedRunner:
                         break
             finally:
                 stop.set()
-            failures = []
+            # failures that raced the loop's last tick
             for w, f in futures.items():
                 exc = f.exception()
                 if exc is None:
@@ -332,8 +359,7 @@ class LocalDistributedRunner:
                 if not self.fault_tolerant:
                     raise exc
                 self._handle_worker_failure(w, exc)
-                failures.append(w)
-            if failures and not self.performers:
+            if not self.performers:
                 raise RuntimeError("all workers failed")
             # drain jobs orphaned by failed workers on the survivors
             # (repeat in case a survivor fails mid-drain); an early stop

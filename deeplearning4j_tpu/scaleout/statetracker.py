@@ -50,6 +50,13 @@ class StateTracker:
     def count(self, key: str) -> float: raise NotImplementedError
     def finish(self) -> None: raise NotImplementedError
     def is_done(self) -> bool: raise NotImplementedError
+    def has_pending_jobs(self) -> bool: raise NotImplementedError
+    # early stopping / best model (ref: tracker earlyStop/bestLoss) — the
+    # runner calls these unconditionally, so they are part of the contract
+    def set_best_loss(self, loss: float) -> None: raise NotImplementedError
+    def best_loss(self) -> float: raise NotImplementedError
+    def early_stop(self) -> None: raise NotImplementedError
+    def is_early_stop(self) -> bool: raise NotImplementedError
 
 
 class InMemoryStateTracker(StateTracker):

@@ -26,9 +26,13 @@ class WorkRouter:
         """Whether the master may hand out the next round of jobs."""
         raise NotImplementedError
 
-    def update(self) -> None:
+    def update(self, updates=None) -> None:
         """Aggregate worker updates into the tracker's current params and
         flag every worker for replication (ref: BaseWorkRouter.update).
+
+        ``updates``: an existing tracker.updates() snapshot to aggregate
+        (so a caller inspecting the round — e.g. early stopping — and the
+        aggregation see the SAME jobs); taken fresh when omitted.
 
         Only the snapshotted updates are cleared: an update published
         between updates() and clear_updates() stays for the next round.
@@ -37,7 +41,8 @@ class WorkRouter:
         newer snapshot from the same worker supersedes an un-aggregated
         older one (it embeds that training), and the identity check here
         guarantees a newer-unseen snapshot is never deleted unaggregated."""
-        updates = self.tracker.updates()
+        if updates is None:
+            updates = self.tracker.updates()
         for job in updates.values():
             self.aggregator.accumulate(job)
         result = self.aggregator.aggregate()

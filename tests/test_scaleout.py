@@ -523,8 +523,96 @@ class TestEarlyStopping:
     def test_async_early_stop_with_orphaned_job_does_not_hang(self):
         """Regression: an early stop while a failed worker's job sits in the
         requeue must not spin the drain loop forever (drain workers exit
-        immediately once the flag is set — orphans are abandoned)."""
+        immediately once the flag is set — orphans are abandoned). The flag
+        is tripped externally mid-run, which both paths honor."""
         import threading
+        import time as _time
+
+        from deeplearning4j_tpu.scaleout import EarlyStopping
+        from deeplearning4j_tpu.scaleout.job import CollectionJobIterator
+        from deeplearning4j_tpu.scaleout.perform import WorkerPerformer
+
+        class CrashOrSlow(WorkerPerformer):
+            def __init__(self, idx):
+                self.idx = idx
+
+            def perform(self, job):
+                if self.idx == 0:
+                    raise RuntimeError("boom")  # its job lands in _requeued
+                _time.sleep(0.005)
+                job.result = np.asarray([1.0])
+                job.score = 5.0
+
+            def update(self, *args):
+                pass
+
+        counter = iter(range(10))
+        tracker = InMemoryStateTracker()
+        runner = LocalDistributedRunner(
+            performer_factory=lambda: CrashOrSlow(next(counter)),
+            job_iterator=CollectionJobIterator(list(range(500))),
+            num_workers=2,
+            tracker=tracker,
+            fault_tolerant=True,
+            router=HogWildWorkRouter(tracker, ParameterAveragingAggregator()),
+            early_stopping=EarlyStopping(patience=2),
+        )
+        t = threading.Thread(target=runner.train, daemon=True)
+        t.start()
+        _time.sleep(0.2)          # let worker-0 crash + worker-1 get going
+        tracker.early_stop()      # external trip mid-run
+        t.join(60)
+        assert not t.is_alive(), "train() hung in the orphan drain loop"
+        assert tracker.is_early_stop()
+        assert tracker.count("jobs_done") < 500  # stopped early
+
+    def test_async_fast_plateaued_worker_does_not_trip_patience(self):
+        """A fast worker with flat loss must not trip early stopping while a
+        slower worker is still improving: evaluation rounds require a fresh
+        score from every reporting worker, so patience is judged on the
+        round MEAN, not on whichever worker publishes most often."""
+        import time as _time
+
+        from deeplearning4j_tpu.scaleout import EarlyStopping
+        from deeplearning4j_tpu.scaleout.job import CollectionJobIterator
+        from deeplearning4j_tpu.scaleout.perform import WorkerPerformer
+
+        class Paced(WorkerPerformer):
+            def __init__(self, idx):
+                self.idx = idx
+                self.loss = 10.0
+
+            def perform(self, job):
+                if self.idx == 0:
+                    _time.sleep(0.001)
+                    job.score = 5.0          # fast, plateaued
+                else:
+                    _time.sleep(0.02)
+                    self.loss *= 0.7         # slow, improving fast
+                    job.score = self.loss
+                job.result = np.asarray([1.0])
+
+            def update(self, *args):
+                pass
+
+        counter = iter(range(10))
+        tracker = InMemoryStateTracker()
+        runner = LocalDistributedRunner(
+            performer_factory=lambda: Paced(next(counter)),
+            job_iterator=CollectionJobIterator(list(range(40))),
+            num_workers=2,
+            tracker=tracker,
+            router=HogWildWorkRouter(tracker, ParameterAveragingAggregator()),
+            early_stopping=EarlyStopping(patience=3),
+        )
+        runner.train()
+        assert not tracker.is_early_stop()
+        assert tracker.count("jobs_done") == 40
+
+    def test_async_crashed_worker_does_not_block_early_stopping(self):
+        """A worker that crashes mid-run is deregistered by the async
+        master's heartbeat (not after the loop), so the early-stopping
+        coverage rule falls to the survivors and can still trip."""
         import time as _time
 
         from deeplearning4j_tpu.scaleout import EarlyStopping
@@ -540,7 +628,7 @@ class TestEarlyStopping:
                     raise RuntimeError("boom")
                 _time.sleep(0.005)
                 job.result = np.asarray([1.0])
-                job.score = 5.0
+                job.score = 5.0  # survivor plateaus forever
 
             def update(self, *args):
                 pass
@@ -549,15 +637,14 @@ class TestEarlyStopping:
         tracker = InMemoryStateTracker()
         runner = LocalDistributedRunner(
             performer_factory=lambda: CrashOrStuck(next(counter)),
-            job_iterator=CollectionJobIterator(list(range(50))),
+            job_iterator=CollectionJobIterator(list(range(300))),
             num_workers=2,
             tracker=tracker,
             fault_tolerant=True,
             router=HogWildWorkRouter(tracker, ParameterAveragingAggregator()),
             early_stopping=EarlyStopping(patience=2),
         )
-        t = threading.Thread(target=runner.train, daemon=True)
-        t.start()
-        t.join(60)
-        assert not t.is_alive(), "train() hung in the orphan drain loop"
+        runner.train()
+        assert tracker.count("worker_failures") == 1
         assert tracker.is_early_stop()
+        assert tracker.count("jobs_done") < 300
