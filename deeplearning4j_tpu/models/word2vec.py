@@ -406,7 +406,18 @@ class Word2Vec:
         epoch in a Python loop, starving the device at corpus scale
         (VERDICT r02 weak #7)."""
         assert self.sentence_iterator is not None, "no sentence iterator configured"
-        native = self._native_vocab_index()
+        # When the native fast path is even possible (cheap non-consuming
+        # guards), materialize the corpus ONCE and feed the same list to both
+        # the native attempt and the fallback — a one-shot (non-resettable)
+        # iterable can never be half-consumed by a native attempt that then
+        # bails (e.g. on non-ASCII text). When it is impossible, stream the
+        # iterator directly: no memory spent on a list nobody joins.
+        native = None
+        if self._native_path_possible():
+            sentences = list(self.sentence_iterator)
+            native = self._native_vocab_index(sentences)
+        else:
+            sentences = self.sentence_iterator
         if native is not None:
             words, counts, self._flat, self._sid = native
             for w, c in zip(words, counts):
@@ -414,7 +425,7 @@ class Word2Vec:
             self.vocab.finish(self.min_word_frequency)
         else:
             corpus_tokens: List[List[str]] = []
-            for sentence in self.sentence_iterator:
+            for sentence in sentences:
                 toks = self.tokenizer_factory.create(sentence).get_tokens()
                 corpus_tokens.append(toks)
                 for tok in toks:
@@ -447,28 +458,43 @@ class Word2Vec:
         self._syn_dev = None      # old-vocab embeddings: free device memory
         self._syn_digest = None
 
-    def _native_vocab_index(self):
+    def _native_path_possible(self) -> bool:
+        """Non-consuming preconditions for the C++ vocab path: plain
+        whitespace tokenizer with no pre-processor, a fresh vocab, and the
+        native library present. None of these touch the sentence iterator,
+        so build_vocab checks them BEFORE deciding whether to materialize
+        the corpus for the native join."""
+        from deeplearning4j_tpu.native.lib import native_available
+        from deeplearning4j_tpu.text.tokenization import DefaultTokenizerFactory
+
+        if type(self.tokenizer_factory) is not DefaultTokenizerFactory:
+            return False
+        if self.tokenizer_factory.pre_processor is not None:
+            return False
+        if not self.vocab.is_empty():
+            return False  # accumulating into an existing vocab: python path
+        return native_available()
+
+    def _native_vocab_index(self, sentences=None):
         """C++ tokenize+count+index fast path (native/text.cpp via
         native/lib.py corpus_index) — the host-side vocab-build hot path the
         reference runs on a JVM actor pool (Word2Vec.java vocab phase +
         VocabActor). Applies only when it is PROVABLY equivalent to the
-        Python path: plain whitespace tokenizer with no pre-processor, a
-        fresh vocab, and ASCII text (byte-wise split/sort == str semantics);
-        returns None otherwise and the Python path runs."""
-        from deeplearning4j_tpu.native.lib import corpus_index, native_available
-        from deeplearning4j_tpu.text.tokenization import DefaultTokenizerFactory
+        Python path (see _native_path_possible, plus ASCII text: byte-wise
+        split/sort == str semantics); returns None otherwise and the Python
+        path runs. ``sentences`` is the materialized corpus from build_vocab
+        — the same list the fallback reads, so bailing out here never costs
+        the caller its iterator (defaults to the configured iterator for
+        direct probing in tests)."""
+        from deeplearning4j_tpu.native.lib import corpus_index
 
-        if type(self.tokenizer_factory) is not DefaultTokenizerFactory:
+        if not self._native_path_possible():
             return None
-        if self.tokenizer_factory.pre_processor is not None:
-            return None
-        if not self.vocab.is_empty():
-            return None  # accumulating into an existing vocab: python path
-        if not native_available():
-            return None  # before materializing the joined corpus for nothing
+        if sentences is None:
+            sentences = self.sentence_iterator
         try:
             text = "\n".join(
-                s.replace("\n", " ") for s in self.sentence_iterator
+                s.replace("\n", " ") for s in sentences
             ).encode("utf-8", errors="strict")
         except UnicodeEncodeError:
             return None

@@ -66,7 +66,7 @@ class LocalDistributedRunner:
         model_saver: Optional[ModelSaver] = None,
         max_rounds: int = 10_000,
         fault_tolerant: bool = False,
-        heartbeat_s: float = 0.002,
+        heartbeat_s: float = 0.05,
         async_timeout_s: Optional[float] = None,
         early_stopping: Optional[EarlyStopping] = None,
     ):
@@ -83,8 +83,11 @@ class LocalDistributedRunner:
         self.model_saver = model_saver
         self.max_rounds = max_rounds
         self.fault_tolerant = fault_tolerant
-        self.heartbeat_s = heartbeat_s  # master aggregation cadence (async
-        #                                 mode; ref: MasterActor 1 s tick)
+        self.heartbeat_s = heartbeat_s  # async-mode idle wake interval for
+        #                                 failure/deadline checks; aggregation
+        #                                 itself is event-driven (the master
+        #                                 wakes the moment a worker publishes;
+        #                                 ref: MasterActor 1 s tick)
         self.async_timeout_s = async_timeout_s  # optional wall-clock cap for
         #                                         the async path (None = run
         #                                         until the iterator drains,
@@ -94,6 +97,7 @@ class LocalDistributedRunner:
         self._es_scores: dict = {}  # worker_id -> latest score this round
         self._requeued: deque = deque()  # jobs orphaned by failed workers
         self._feed_lock = threading.Lock()  # guards iterator+requeued (async)
+        self._update_arrived = threading.Event()  # wakes the async master
         self._async_jobs_left = 0  # set by _train_async (max_rounds bound)
         for worker_id in self.performers:
             self.tracker.add_worker(worker_id)
@@ -120,6 +124,7 @@ class LocalDistributedRunner:
         self.tracker.increment("job_ms_total",
                                (time.perf_counter() - t0) * 1000.0)
         self.tracker.add_update(worker_id, job)
+        self._update_arrived.set()  # wake the async master's heartbeat
         self.tracker.clear_job(worker_id)
         self.tracker.increment("jobs_done")
         self.tracker.increment(f"rounds.{worker_id}")
@@ -310,7 +315,16 @@ class LocalDistributedRunner:
             last_save = 0.0
             try:
                 while any(not f.done() for f in futures.values()):
-                    time.sleep(self.heartbeat_s)
+                    # event-driven heartbeat: wake when a worker publishes
+                    # (set in _perform_and_publish) instead of busy-polling —
+                    # a 2 ms sleep loop costs ~500 GIL wakeups/s that starve
+                    # perform() on a 1-core host. heartbeat_s only bounds
+                    # failure detection / deadline checks when no updates
+                    # flow; aggregation latency does not depend on it.
+                    self._update_arrived.wait(timeout=self.heartbeat_s)
+                    # clear BEFORE snapshotting: an add_update racing this
+                    # line either lands in the snapshot or re-sets the event
+                    self._update_arrived.clear()
                     # deregister crashed workers NOW, not after the loop:
                     # a dead worker left in self.performers would block the
                     # early-stopping coverage rule for the whole run (ref

@@ -31,7 +31,7 @@ Virtual CPU "devices" share the host's core(s) (`nproc` is recorded in the
 artifact), so wall-clock cannot weak-scale here; the reference's own test
 posture has the same property (Spark local[8] on one socket).
 
-Run:  python scaling_bench.py  →  prints JSON and writes SCALING_r04.json
+Run:  python scaling_bench.py  →  prints JSON and writes SCALING_r05.json
 """
 
 from __future__ import annotations
@@ -45,7 +45,7 @@ PER_DEVICE_BATCH = 256
 STEPS = 30
 WARMUP = 5
 REPEATS = 3
-OUT = "SCALING_r04.json"
+OUT = "SCALING_r05.json"
 
 _CHILD = r"""
 import sys, time, json
@@ -83,18 +83,23 @@ param_bytes = sum(int(jnp.size(l)) * 4 for layer in params
 for i in range({warmup}):
     params, states, score = step(params, states, jnp.asarray(i), x, y, w, key)
 jax.block_until_ready(params)
-# best-of-R repeats: a 1-core host makes single timings noisy under any
-# transient background load; the minimum is the uncontended step time
-best = float("inf")
+# R repeats, ALL reported: a 1-core host makes single timings noisy under
+# transient background load. The minimum is the uncontended step time; the
+# parent records the min/median spread so subtraction-based attribution can
+# be flagged when it sits inside the repeat noise instead of silently
+# clamped (advisor r04).
+reps = []
 for _ in range({repeats}):
     t0 = time.perf_counter()
     for i in range({steps}):
         params, states, score = step(params, states, jnp.asarray(i), x, y, w, key)
     jax.block_until_ready(params)
-    best = min(best, time.perf_counter() - t0)
-dt = best
+    reps.append(time.perf_counter() - t0)
 assert bool(jnp.isfinite(score)), "non-finite score"
-print("RES", json.dumps({{"ms": dt / {steps} * 1000.0,
+import statistics
+print("RES", json.dumps({{"ms": min(reps) / {steps} * 1000.0,
+                          "ms_median": statistics.median(reps) / {steps} * 1000.0,
+                          "ms_repeats": [r / {steps} * 1000.0 for r in reps],
                           "all_reduce_ops": n_allreduce,
                           "param_bytes": param_bytes}}))
 """
@@ -124,20 +129,36 @@ def main() -> None:
         param_bytes = dp["param_bytes"]
         dp_ms = dp["ms"]
         if n == 1:
+            abl = dp
             abl_ms = dp_ms
             single_ms = dp_ms
         else:
-            abl_ms = measure(n, gb, "ablate")["ms"]
+            abl = measure(n, gb, "ablate")
+            abl_ms = abl["ms"]
             single_ms = measure(1, gb, "dp")["ms"]
-        coll_ms = max(dp_ms - abl_ms, 0.0)
+        # collective_ms subtracts minima from two subprocesses; on a noisy
+        # shared host the two minima can come from different contention
+        # regimes. Record the raw (possibly negative) difference plus each
+        # side's min→median spread, and flag the row when |diff| sits inside
+        # that spread — never silently clamp (advisor r04).
+        raw_diff = dp_ms - abl_ms
+        spread = ((dp["ms_median"] - dp_ms) + (abl["ms_median"] - abl_ms))
+        coll_ms = max(raw_diff, 0.0)
         rows.append({
             "devices": n,
             "per_device_batch": PER_DEVICE_BATCH,
             "global_batch": gb,
             "dp_step_ms": round(dp_ms, 3),
+            "dp_step_ms_median": round(dp["ms_median"], 3),
             "ablated_step_ms": round(abl_ms, 3),
+            "ablated_step_ms_median": round(abl["ms_median"], 3),
             "single_device_same_batch_ms": round(single_ms, 3),
             "collective_ms": round(coll_ms, 3),
+            "collective_ms_raw_diff": round(raw_diff, 3),
+            "collective_within_noise": bool(abs(raw_diff) <= spread),
+            "repeat_spread_ms": round(spread, 3),
+            "dp_step_ms_repeats": [round(r, 3) for r in dp["ms_repeats"]],
+            "ablated_step_ms_repeats": [round(r, 3) for r in abl["ms_repeats"]],
             "mesh_overhead_ms": round(abl_ms - single_ms, 3),
             "dp_overhead_efficiency": round(single_ms / dp_ms, 3),
             "collective_only_efficiency": round(

@@ -226,7 +226,7 @@ class TestNativeCorpusIndex:
             layer_size=8, min_word_frequency=min_count, seed=1,
         )
         # force the python path regardless of library availability
-        w._native_vocab_index = lambda: None
+        w._native_path_possible = lambda: False
         w.build_vocab()
         return w
 
