@@ -1,0 +1,306 @@
+"""Blockwise (flash-style) attention: O(T) memory, (T,T) never materialized.
+
+The reference is pre-transformer and has no attention at all (SURVEY.md
+§2.5); this module is the long-context core behind the framework's ATTENTION
+layer (nn/layers/attention.py) and completes round 4's toy-shape story with
+an on-chip path that holds at real sequence lengths.
+
+Two implementations behind one dispatcher (``attention_core``):
+
+- ``blockwise_attention`` — portable lax.scan/fori_loop online-softmax over
+  K/V blocks with a hand-written flash-style custom VJP: the forward saves
+  only (q, k, v, o, logsumexp) — O(B·H·T·D) — and the backward recomputes
+  scores block-by-block (dq pass over q-blocks, dk/dv pass over k-blocks).
+  Under a causal mask the inner loops stop at the diagonal block, so the
+  masked half of the score rectangle is never computed. Runs everywhere
+  (CPU tests, TPU, inside shard_map bodies).
+- the in-tree pallas TPU flash kernel
+  (jax.experimental.pallas.ops.tpu.flash_attention) — the fused VMEM-resident
+  kernel, available via ``set_attention_impl("flash")``.
+
+Measured on v5e (steady-state interleaved A/B, train step = grad of sum(o²),
+B=8 H=4 D=128 bf16, full-rectangle MFU accounting): at T=2048 the blockwise
+scan hits 0.71 vs the pallas kernel's 0.61 and XLA-dense's 0.30; at T=8192
+(B=2) blockwise 1.00 vs pallas 0.89 — XLA compiles the static q-block loop +
+fori_loop into a better schedule than the hand-tiled kernel on this chip, so
+AUTO PREFERS BLOCKWISE everywhere and the pallas kernel stays as an option.
+
+Numerics: scores and the online-softmax state are f32 regardless of input
+dtype (bf16 inputs hit the MXU as bf16, accumulation stays f32), matching
+``parallel/ring_attention.py``'s accumulation math — ring attention is this
+same algorithm with the block loop unrolled over ICI neighbors instead of
+a local scan.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_NEG_INF = -1e30
+
+# dispatcher override: None = auto (blockwise scan for long block-aligned T
+# — measured faster than the pallas kernel, see module docstring — dense
+# reference for short T); "flash" | "blockwise" | "dense" force one path
+_impl_override: Optional[str] = None
+
+# dense path below this length: at tiny T the (T,T) buffer is cheap and the
+# block loop's fixed overhead dominates
+_BLOCKWISE_MIN_T = 1024
+_DEFAULT_BLOCK = 512
+
+
+def set_attention_impl(impl: Optional[str]) -> None:
+    """Force the attention core: "flash" (pallas TPU kernel), "blockwise"
+    (portable scan), "dense" (materializing reference), or None for auto."""
+    if impl not in (None, "flash", "blockwise", "dense"):
+        raise ValueError(f"unknown attention impl {impl!r}; "
+                         "options: flash, blockwise, dense, None")
+    global _impl_override
+    _impl_override = impl
+
+
+def get_attention_impl() -> Optional[str]:
+    return _impl_override
+
+
+# ------------------------------------------------------------------ dense ----
+
+def dense_attention(q: Array, k: Array, v: Array, causal: bool = False) -> Array:
+    """Materializing reference (identical math to
+    parallel/ring_attention.reference_attention)."""
+    from deeplearning4j_tpu.parallel.ring_attention import reference_attention
+
+    return reference_attention(q, k, v, causal=causal)
+
+
+# -------------------------------------------------------- blockwise (scan) ----
+
+def _causal_bias(qi: int, j, bq: int, bk: int, dtype):
+    """(bq, bk) additive bias for q-block qi vs k-block j (j may be traced)."""
+    q_pos = qi * bq + jnp.arange(bq)
+    k_pos = j * bk + jnp.arange(bk)
+    return jnp.where(q_pos[:, None] >= k_pos[None, :], 0.0, _NEG_INF
+                     ).astype(dtype)
+
+
+def _fwd_q_block(qi_idx: int, q_blk, kb, vb, scale, causal, bq, bk, nk):
+    """One q-block's online-softmax over its K/V blocks.
+
+    q_blk: (B,H,bq,D); kb/vb: (nk,B,H,bk,D). Returns (o, lse) with
+    o: (B,H,bq,D) f32, lse: (B,H,bq) f32."""
+    limit = min((qi_idx * bq + bq - 1) // bk + 1, nk) if causal else nk
+
+    def step(j, carry):
+        o, l, m = carry
+        kj = jax.lax.dynamic_index_in_dim(kb, j, 0, keepdims=False)
+        vj = jax.lax.dynamic_index_in_dim(vb, j, 0, keepdims=False)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q_blk, kj,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = s + _causal_bias(qi_idx, j, bq, bk, s.dtype)[None, None]
+        bm = s.max(axis=-1)
+        m_new = jnp.maximum(m, bm)
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        pv = jnp.einsum("bhqk,bhkd->bhqd", p.astype(vj.dtype), vj,
+                        preferred_element_type=jnp.float32)
+        o = o * alpha[..., None] + pv
+        l = l * alpha + p.sum(-1)
+        return o, l, m_new
+
+    b, h, _, d = q_blk.shape
+    o0 = jnp.zeros((b, h, bq, d), jnp.float32)
+    l0 = jnp.zeros((b, h, bq), jnp.float32)
+    m0 = jnp.full((b, h, bq), _NEG_INF, jnp.float32)
+    o, l, m = jax.lax.fori_loop(0, limit, step, (o0, l0, m0))
+    l = jnp.maximum(l, 1e-30)  # fully-masked rows (impossible when causal
+    #                            self-attn: position t sees itself) — guard
+    return o / l[..., None], m + jnp.log(l)
+
+
+def _blockwise_fwd_impl(q, k, v, causal, bq, bk):
+    b, h, t, d = q.shape
+    nq, nk = t // bq, t // bk
+    scale = 1.0 / (d ** 0.5)
+    kb = k.reshape(b, h, nk, bk, d).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(b, h, nk, bk, d).transpose(2, 0, 1, 3, 4)
+
+    os, lses = [], []
+    # python loop over q blocks, unrolled at trace time: nq is small
+    # (T/512), each iteration is big MXU work, and the causal inner-loop
+    # bound is static per block so masked blocks cost nothing
+    for i in range(nq):
+        q_blk = jax.lax.dynamic_slice_in_dim(q, i * bq, bq, axis=2)
+        o_i, lse_i = _fwd_q_block(i, q_blk, kb, vb, scale, causal, bq, bk, nk)
+        os.append(o_i)
+        lses.append(lse_i)
+    o = jnp.concatenate(os, axis=2).astype(q.dtype)
+    lse = jnp.concatenate(lses, axis=2)
+    return o, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def blockwise_attention(q: Array, k: Array, v: Array, causal: bool = False,
+                        block_q: int = _DEFAULT_BLOCK,
+                        block_k: int = _DEFAULT_BLOCK) -> Array:
+    """softmax(q·kᵀ/√d)·v over (B,H,T,D) without materializing (T,T).
+
+    T must divide by the block sizes (callers clamp blocks to T). Memory is
+    O(B·H·T·D): the forward keeps (o, logsumexp) only and the backward
+    recomputes per-block scores — the flash attention recipe in lax."""
+    o, _ = _blockwise_fwd_impl(q, k, v, causal, block_q, block_k)
+    return o
+
+
+def _blockwise_vjp_fwd(q, k, v, causal, bq, bk):
+    o, lse = _blockwise_fwd_impl(q, k, v, causal, bq, bk)
+    return o, (q, k, v, o, lse)
+
+
+def _blockwise_vjp_bwd(causal, bq, bk, res, do):
+    q, k, v, o, lse = res
+    b, h, t, d = q.shape
+    nq, nk = t // bq, t // bk
+    scale = 1.0 / (d ** 0.5)
+    do_f = do.astype(jnp.float32)
+    # delta_i = rowsum(do ∘ o): the dL/dsoftmax-normalizer term
+    delta = jnp.sum(do_f * o.astype(jnp.float32), axis=-1)  # (B,H,T)
+
+    kb = k.reshape(b, h, nk, bk, d).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(b, h, nk, bk, d).transpose(2, 0, 1, 3, 4)
+
+    def p_block(q_blk, kj, lse_blk, qi, j):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q_blk, kj,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = s + _causal_bias(qi, j, bq, bk, s.dtype)[None, None]
+        return jnp.exp(s - lse_blk[..., None])  # (B,H,bq,bk) f32
+
+    # ---- dq: per q-block, loop its k blocks ----
+    dqs = []
+    for i in range(nq):
+        q_blk = jax.lax.dynamic_slice_in_dim(q, i * bq, bq, axis=2)
+        do_blk = jax.lax.dynamic_slice_in_dim(do_f, i * bq, bq, axis=2)
+        lse_blk = jax.lax.dynamic_slice_in_dim(lse, i * bq, bq, axis=2)
+        dl_blk = jax.lax.dynamic_slice_in_dim(delta, i * bq, bq, axis=2)
+        limit = min((i * bq + bq - 1) // bk + 1, nk) if causal else nk
+
+        def dq_step(j, acc, q_blk=q_blk, do_blk=do_blk, lse_blk=lse_blk,
+                    dl_blk=dl_blk, qi=i):
+            kj = jax.lax.dynamic_index_in_dim(kb, j, 0, keepdims=False)
+            vj = jax.lax.dynamic_index_in_dim(vb, j, 0, keepdims=False)
+            p = p_block(q_blk, kj, lse_blk, qi, j)
+            dp = jnp.einsum("bhqd,bhkd->bhqk", do_blk, vj.astype(jnp.float32),
+                            preferred_element_type=jnp.float32)
+            ds = p * (dp - dl_blk[..., None])
+            return acc + jnp.einsum("bhqk,bhkd->bhqd", ds,
+                                    kj.astype(jnp.float32),
+                                    preferred_element_type=jnp.float32) * scale
+
+        acc0 = jnp.zeros((b, h, bq, d), jnp.float32)
+        dqs.append(jax.lax.fori_loop(0, limit, dq_step, acc0))
+    dq = jnp.concatenate(dqs, axis=2).astype(q.dtype)
+
+    # ---- dk/dv: per k-block, loop the q blocks that see it ----
+    qb_ = q.reshape(b, h, nq, bq, d).transpose(2, 0, 1, 3, 4)
+    dob = do_f.reshape(b, h, nq, bq, d).transpose(2, 0, 1, 3, 4)
+    lseb = lse.reshape(b, h, nq, bq).transpose(2, 0, 1, 3)
+    deltab = delta.reshape(b, h, nq, bq).transpose(2, 0, 1, 3)
+
+    dks, dvs = [], []
+    for j in range(nk):
+        kj = kb[j]
+        vj = vb[j]
+        start = (j * bk) // bq if causal else 0
+
+        def dkv_step(i, carry, kj=kj, vj=vj, kj_idx=j):
+            dk_acc, dv_acc = carry
+            q_blk = jax.lax.dynamic_index_in_dim(qb_, i, 0, keepdims=False)
+            do_blk = jax.lax.dynamic_index_in_dim(dob, i, 0, keepdims=False)
+            lse_blk = jax.lax.dynamic_index_in_dim(lseb, i, 0, keepdims=False)
+            dl_blk = jax.lax.dynamic_index_in_dim(deltab, i, 0, keepdims=False)
+            if causal:
+                # traced q-block index vs static k-block: mask inside p_block
+                # needs the q-block index; compute bias with traced qi
+                q_pos = i * bq + jnp.arange(bq)
+                k_pos = kj_idx * bk + jnp.arange(bk)
+                bias = jnp.where(q_pos[:, None] >= k_pos[None, :], 0.0,
+                                 _NEG_INF)[None, None]
+            else:
+                bias = None
+            s = jnp.einsum("bhqd,bhkd->bhqk", q_blk, kj,
+                           preferred_element_type=jnp.float32) * scale
+            if bias is not None:
+                s = s + bias
+            p = jnp.exp(s - lse_blk[..., None])
+            dv_acc = dv_acc + jnp.einsum("bhqk,bhqd->bhkd", p, do_blk,
+                                         preferred_element_type=jnp.float32)
+            dp = jnp.einsum("bhqd,bhkd->bhqk", do_blk, vj.astype(jnp.float32),
+                            preferred_element_type=jnp.float32)
+            ds = p * (dp - dl_blk[..., None])
+            dk_acc = dk_acc + jnp.einsum("bhqk,bhqd->bhkd", ds,
+                                         q_blk.astype(jnp.float32),
+                                         preferred_element_type=jnp.float32
+                                         ) * scale
+            return dk_acc, dv_acc
+
+        z = jnp.zeros((b, h, bk, d), jnp.float32)
+        dk_j, dv_j = jax.lax.fori_loop(start, nq, dkv_step, (z, z))
+        dks.append(dk_j)
+        dvs.append(dv_j)
+    dk = jnp.concatenate(dks, axis=2).astype(k.dtype)
+    dv = jnp.concatenate(dvs, axis=2).astype(v.dtype)
+    return dq, dk, dv
+
+
+blockwise_attention.defvjp(_blockwise_vjp_fwd, _blockwise_vjp_bwd)
+
+
+# ----------------------------------------------------- pallas flash (TPU) ----
+
+def _flash_attention_tpu(q: Array, k: Array, v: Array, causal: bool) -> Array:
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes,
+        flash_attention,
+    )
+
+    t = q.shape[2]
+    blk = min(_DEFAULT_BLOCK, t)
+    bs = BlockSizes(
+        block_q=blk, block_k_major=blk, block_k=blk, block_b=1,
+        block_q_major_dkv=blk, block_k_major_dkv=blk,
+        block_k_dkv=blk, block_q_dkv=blk,
+        block_k_major_dq=blk, block_k_dq=blk, block_q_dq=blk,
+    )
+    return flash_attention(q, k, v, causal=causal,
+                           sm_scale=1.0 / (q.shape[-1] ** 0.5),
+                           block_sizes=bs)
+
+
+# ------------------------------------------------------------- dispatcher ----
+
+def attention_core(q: Array, k: Array, v: Array, causal: bool = False) -> Array:
+    """The ATTENTION layer's dense core: picks the fastest correct
+    implementation for the shape/platform (override with
+    ``set_attention_impl``). All paths compute the identical function;
+    parity is pinned in tests/test_flash_attention.py."""
+    impl = _impl_override
+    if impl is None:
+        t = q.shape[2]
+        if t >= _BLOCKWISE_MIN_T and t % min(_DEFAULT_BLOCK, t) == 0:
+            impl = "blockwise"  # measured faster than the pallas kernel on
+            #                     v5e at T=2048 and T=8192 (module docstring)
+        else:
+            impl = "dense"
+    if impl == "flash":
+        return _flash_attention_tpu(q, k, v, causal)
+    if impl == "blockwise":
+        blk = min(_DEFAULT_BLOCK, q.shape[2])
+        return blockwise_attention(q, k, v, causal, blk, blk)
+    return dense_attention(q, k, v, causal)
