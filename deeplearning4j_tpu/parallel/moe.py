@@ -1,20 +1,30 @@
-"""Expert parallelism: capacity-based top-1 mixture-of-experts dispatch.
+"""Expert parallelism: capacity-based top-1/top-2 mixture-of-experts.
 
 The reference has no MoE (SURVEY.md §2.5); this completes the framework's
 parallelism axes (dp/tp/sp/pp/ep). Each device on the "expert" mesh axis
 owns ONE expert's parameters. Dispatch is the TPU-shaped capacity design:
 
-  1. a shared router scores every token; top-1 assignment per token
-  2. each device gathers the first C tokens assigned to ITS expert
+  1. a shared router scores every token; top-k (k ∈ {1, 2}) assignment per
+     token, gates = the chosen experts' softmax probs (renormalized to sum
+     to 1 for k = 2, the GShard/Mixtral convention)
+  2. each device gathers the first C tokens routed to ITS expert
      (C = capacity; overflow tokens are dropped, the standard trade that
      keeps every shape static for XLA)
   3. the expert computes on its (C, d) slice only — per-device FLOPs are
-     O(C), not O(N)
-  4. outputs scatter back to token positions scaled by the router
-     probability, and a psum over the expert axis combines the shards.
-     Dropped (overflow) tokens contribute EXACTLY ZERO rows — callers
-     embedding this in a block must add their own residual around it if
-     dropped tokens should keep their representation
+     O(C·k), not O(N)
+  4. outputs scatter back to token positions scaled by the gate, and a
+     psum over the expert axis combines the shards (a top-2 token sums its
+     two experts' weighted outputs). Dropped (overflow) tokens contribute
+     EXACTLY ZERO rows — callers embedding this in a block must add their
+     own residual around it if dropped tokens should keep their
+     representation
+
+Training quality: without pressure toward uniform routing a trained router
+collapses onto one expert; ``load_balance_loss`` is the Switch-Transformer
+auxiliary (E · Σ_e f_e·P_e, f = dispatch fraction, P = mean router prob —
+minimized at uniform routing, where it equals 1). Add it to the task loss
+with a small weight (~1e-2); tests/test_moe.py shows a short training run
+staying balanced with it and collapsing without it.
 
 Everything is differentiable (gather/scatter/psum transpose cleanly), so
 ``jax.grad`` trains router and experts together; parity and gradient tests
@@ -34,19 +44,30 @@ Array = jax.Array
 EXPERT_AXIS = "expert"
 
 
+def _routing(logits, top_k: int):
+    """(N, E) logits → (idx (N,k), gates (N,k)). Gates are softmax probs of
+    the chosen experts, renormalized to sum to 1 when k > 1 (GShard)."""
+    probs = jax.nn.softmax(logits, axis=-1)
+    _, idx = jax.lax.top_k(logits, top_k)  # (N, k)
+    g = jnp.take_along_axis(probs, idx, axis=1)  # (N, k)
+    if top_k > 1:
+        g = g / jnp.maximum(g.sum(-1, keepdims=True), 1e-9)
+    return idx, g
+
+
 def _dispatch_local(expert_params, router_w, x, capacity: int,
-                    axis_name: str, expert_fn: Callable):
+                    axis_name: str, expert_fn: Callable, top_k: int):
     """Per-device body under shard_map. x: (N, d) replicated tokens;
     expert_params: this expert's params (stage axis stripped)."""
     my = jax.lax.axis_index(axis_name)
     n, d = x.shape
 
     logits = x @ router_w  # (N, E) — router is replicated, computed locally
-    probs = jax.nn.softmax(logits, axis=-1)
-    assign = jnp.argmax(logits, axis=-1)  # (N,) top-1 expert id
-    gate = jnp.take_along_axis(probs, assign[:, None], axis=1)[:, 0]  # (N,)
+    idx, gates = _routing(logits, top_k)
+    mine_k = idx == my  # (N, k): which of the token's choices is this expert
+    mine = mine_k.any(-1)  # a token picks each expert at most once
+    gate_here = jnp.sum(gates * mine_k, axis=-1)  # (N,)
 
-    mine = assign == my  # (N,)
     # positions of the first `capacity` tokens routed here: rank tokens by
     # (not-mine, position) so mine-in-order come first, then slice C
     order = jnp.argsort(jnp.where(mine, jnp.arange(n), n + jnp.arange(n)))
@@ -55,24 +76,38 @@ def _dispatch_local(expert_params, router_w, x, capacity: int,
 
     tokens = x[slots] * slot_valid[:, None]
     y = expert_fn(expert_params, tokens)  # (C, d) — the O(C) expert compute
-    y = y * (gate[slots] * slot_valid)[:, None]
+    y = y * (gate_here[slots] * slot_valid)[:, None]
 
     out = jnp.zeros((n, d), x.dtype).at[slots].add(y)
-    # combine expert shards; each token was computed on ≤1 device
+    # combine expert shards; a top-2 token sums its two experts' outputs
     return jax.lax.psum(out, axis_name)
 
 
 def moe_apply(router_w: Array, expert_params, x: Array, mesh: Mesh,
               expert_fn: Callable, capacity: int,
-              axis: str = EXPERT_AXIS) -> Array:
-    """Top-1 MoE over experts sharded on ``axis``.
+              axis: str = EXPERT_AXIS, top_k: int = 1,
+              token_axes: tuple = ()) -> Array:
+    """Top-k (k ∈ {1, 2}) MoE over experts sharded on ``axis``.
 
     router_w: (d, E) replicated; expert_params: pytree with a leading
     expert axis of size E (sharded onto ``axis``); x: (N, d).
     Returns (N, d); tokens beyond an expert's capacity contribute zeros
-    (count them with expected_dropped for capacity tuning).
+    (count them with expected_dropped for capacity tuning). For training,
+    add ``load_balance_loss(router_w, x)`` to the task loss (weight ~1e-2)
+    or the router collapses experts.
+
+    ``token_axes`` composes dp/sp×ep on a multi-axis mesh: the token dim N
+    is sharded over those mesh axes, so each token-shard row routes its own
+    tokens to the experts along ``axis`` (capacity then applies PER token
+    shard — scale it by 1/prod(token_axes sizes) for the same global drop
+    behavior). Expert-param gradients are psummed over the token axes
+    automatically by shard_map's transpose.
     """
+    if top_k not in (1, 2):
+        raise ValueError(f"top_k must be 1 or 2, got {top_k}")
     n_experts = mesh.shape[axis]
+    if top_k > n_experts:
+        raise ValueError(f"top_k={top_k} > {n_experts} experts")
     if router_w.shape[1] != n_experts:
         raise ValueError(
             f"router_w has {router_w.shape[1]} experts but mesh axis "
@@ -87,39 +122,65 @@ def moe_apply(router_w: Array, expert_params, x: Array, mesh: Mesh,
 
     def body(params, rw, xs):
         local = jax.tree_util.tree_map(lambda a: a[0], params)
-        return _dispatch_local(local, rw, xs, capacity, axis, expert_fn)
+        return _dispatch_local(local, rw, xs, capacity, axis, expert_fn,
+                               top_k)
 
+    tok_spec = P(tuple(token_axes) if token_axes else None)
     return jax.shard_map(
         body, mesh=mesh,
-        in_specs=(param_spec, P(), P()), out_specs=P(),
+        in_specs=(param_spec, P(), tok_spec), out_specs=tok_spec,
         check_vma=False,
     )(expert_params, router_w, x)
 
 
-def expected_dropped(router_w: Array, x: Array, capacity: int) -> int:
-    """How many tokens overflow their expert's capacity for this batch."""
-    assign = jnp.argmax(x @ router_w, axis=-1)
+def load_balance_loss(router_w: Array, x: Array) -> Array:
+    """Switch-Transformer auxiliary load-balancing loss: E · Σ_e f_e · P_e
+    with f_e the fraction of tokens whose TOP-1 choice is e (stop-gradient
+    through the argmax, as in the paper) and P_e the mean router
+    probability. Equals 1 at perfectly uniform routing; add to the task
+    loss with a small weight (1e-2 is the standard setting)."""
+    logits = x @ router_w
+    probs = jax.nn.softmax(logits, axis=-1)
     n_experts = router_w.shape[1]
-    counts = jnp.bincount(assign, length=n_experts)
+    f = jnp.mean(jax.nn.one_hot(jnp.argmax(logits, -1), n_experts), axis=0)
+    p_mean = jnp.mean(probs, axis=0)
+    return n_experts * jnp.sum(f * p_mean)
+
+
+def expert_load(router_w: Array, x: Array, top_k: int = 1) -> Array:
+    """(E,) count of tokens routed to each expert (any of their k choices)
+    — the balance diagnostic used by tests and capacity tuning."""
+    idx, _ = _routing(x @ router_w, top_k)
+    n_experts = router_w.shape[1]
+    return jnp.bincount(idx.reshape(-1), length=n_experts)
+
+
+def expected_dropped(router_w: Array, x: Array, capacity: int,
+                     top_k: int = 1) -> int:
+    """How many (token, expert) routes overflow an expert's capacity."""
+    counts = expert_load(router_w, x, top_k)
     return int(jnp.sum(jnp.maximum(counts - capacity, 0)))
 
 
 def moe_reference(router_w: Array, expert_params_list, x: Array,
-                  expert_fn: Callable, capacity: int) -> Array:
+                  expert_fn: Callable, capacity: int,
+                  top_k: int = 1) -> Array:
     """Dense single-device reference with IDENTICAL routing + capacity
     semantics (for tests)."""
     import numpy as np
 
-    logits = np.asarray(x @ router_w)
-    probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
-    assign = logits.argmax(-1)
+    logits = x @ router_w
+    idx, gates = _routing(logits, top_k)
+    idx, gates = np.asarray(idx), np.asarray(gates)
     out = np.zeros(np.asarray(x).shape, np.float32)
     for e, params in enumerate(expert_params_list):
-        idx = np.nonzero(assign == e)[0][:capacity]
-        if idx.size == 0:
+        routed_here = (idx == e)  # (N, k)
+        tok = np.nonzero(routed_here.any(-1))[0][:capacity]
+        if tok.size == 0:
             continue
-        y = np.asarray(expert_fn(params, jnp.asarray(np.asarray(x)[idx])))
-        out[idx] = y * probs[idx, e][:, None]
+        y = np.asarray(expert_fn(params, jnp.asarray(np.asarray(x)[tok])))
+        g = (gates[tok] * routed_here[tok]).sum(-1)
+        out[tok] += y * g[:, None]
     return jnp.asarray(out)
 
 
