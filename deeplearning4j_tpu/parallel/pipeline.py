@@ -36,13 +36,14 @@ def _pipeline_body(stage_params, x_mbs, stage_fn, axis_name: str):
 
     stage_params: this stage's params (leading stage axis of size 1 removed
     by the caller's specs — each leaf arrives as its own stage's slice).
-    x_mbs: (M, mb, d) microbatches, replicated (only stage 0 reads them).
-    Returns (M, mb, d): the pipeline output, replicated via psum (only the
-    last stage contributes non-zeros).
+    x_mbs: (M, mb, ...) microbatches — any trailing activation shape (d) for
+    dense stacks, (T, d) for sequence models — replicated over the pipe axis
+    (only stage 0 reads them). Returns (M, mb, ...): the pipeline output,
+    replicated via psum (only the last stage contributes non-zeros).
     """
     n_stages = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
-    n_micro, mb, d = x_mbs.shape
+    n_micro = x_mbs.shape[0]
     ticks = n_micro + n_stages - 1
     fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
@@ -65,8 +66,8 @@ def _pipeline_body(stage_params, x_mbs, stage_fn, axis_name: str):
         recv_next = jax.lax.ppermute(y, axis_name, fwd)
         return (recv_next, outputs), None
 
-    recv0 = jnp.zeros((mb, d), x_mbs.dtype)
-    out0 = jnp.zeros((n_micro, mb, d), x_mbs.dtype)
+    recv0 = jnp.zeros(x_mbs.shape[1:], x_mbs.dtype)
+    out0 = jnp.zeros(x_mbs.shape, x_mbs.dtype)
     (_, outputs), _ = jax.lax.scan(tick, (recv0, out0), jnp.arange(ticks))
     # replicate the last stage's outputs everywhere (other stages hold zeros)
     mask = (my == n_stages - 1).astype(x_mbs.dtype)
@@ -74,13 +75,21 @@ def _pipeline_body(stage_params, x_mbs, stage_fn, axis_name: str):
 
 
 def pipeline_apply(stage_params, x_mbs: Array, stage_fn: Callable,
-                   mesh: Mesh, axis: str = PIPE_AXIS) -> Array:
+                   mesh: Mesh, axis: str = PIPE_AXIS,
+                   batch_axis: "str | None" = None) -> Array:
     """Run microbatches through the stage pipeline.
 
     stage_params: pytree whose leaves have a leading STAGE axis of size S
     (sharded onto ``axis``); ``stage_fn(params_slice, x) -> y`` applies one
-    stage with that axis already stripped. x_mbs: (M, mb, d) microbatches.
-    Returns (M, mb, d) outputs, replicated.
+    stage with that axis already stripped. x_mbs: (M, mb, ...) microbatches
+    (any trailing activation shape). Returns (M, mb, ...) outputs.
+
+    ``batch_axis`` composes dp×pp on a 2-D mesh: the microbatch dim mb is
+    sharded over that mesh axis, so each data-parallel row runs the same
+    tick schedule on its own batch shard (activations hop stage-to-stage
+    within the row). Gradients for the stage params are psummed over the
+    batch axis automatically by shard_map's transpose (params are
+    replicated along it).
     """
     n_stages = mesh.shape[axis]
     for leaf in jax.tree_util.tree_leaves(stage_params):
@@ -90,6 +99,7 @@ def pipeline_apply(stage_params, x_mbs: Array, stage_fn: Callable,
                 f"{n_stages} — a mismatch would silently run a different "
                 "(interleaved-stage) model")
     param_spec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    x_spec = P(None, batch_axis)  # (M, mb, ...): mb sharded for dp×pp
 
     def body(params, x):
         # strip the per-device stage axis (size 1 after sharding)
@@ -98,7 +108,7 @@ def pipeline_apply(stage_params, x_mbs: Array, stage_fn: Callable,
 
     return jax.shard_map(
         body, mesh=mesh,
-        in_specs=(param_spec, P()), out_specs=P(),
+        in_specs=(param_spec, x_spec), out_specs=x_spec,
         check_vma=False,
     )(stage_params, x_mbs)
 
@@ -163,19 +173,92 @@ def pipeline_from_conf(conf, params, mesh: Mesh, layers=None,
     return shard_stage_params(stacked, mesh, axis), stage_fn
 
 
+def heterogeneous_pipeline_from_conf(conf, params, mesh: Mesh,
+                                     axis: str = PIPE_AXIS):
+    """Stage an ENTIRE dense/output MultiLayerConfiguration onto the pipe
+    mesh, one layer per device, with NON-uniform widths — the bridge that
+    lets zoo models (mnist_mlp, digits_mlp, …) train through the pipeline
+    rather than only synthetic d→d stacks.
+
+    The shape uniformity ``ppermute`` requires is recovered by padding:
+    every stage's weight is embedded in a (dmax, dmax) zero block, biases
+    in (dmax,), and activations travel as (mb, dmax). Each device selects
+    its own layer's math with ``lax.switch`` on its stage index — the
+    branch statically slices x[:, :n_in], applies the layer forward
+    (dense/output, including the activation), and zero-pads back to dmax.
+    Padded lanes carry exact zeros end-to-end, so gradients in the padding
+    are zero and training matches the unpadded network exactly (pinned in
+    tests/test_pipeline.py).
+
+    Returns (stacked_sharded_params, stage_fn, out_width): feed the first
+    two to pipeline_apply / make_pipeline_train_step; slice the pipeline
+    output to [..., :out_width] before the loss.
+    """
+    from deeplearning4j_tpu.nn.api import LayerType
+    from deeplearning4j_tpu.nn.layers import dense as dense_layer
+    from deeplearning4j_tpu.nn.layers import output as output_layer
+    from deeplearning4j_tpu.nn.params import BIAS_KEY, WEIGHT_KEY
+
+    n_stages = mesh.shape[axis]
+    if conf.n_layers != n_stages:
+        raise ValueError(
+            f"{conf.n_layers} layers for a {n_stages}-device pipe axis — "
+            "heterogeneous staging is one layer per stage")
+    confs = [conf.conf(i) for i in range(conf.n_layers)]
+    for i, c in enumerate(confs):
+        if c.layer_type not in (LayerType.DENSE, LayerType.OUTPUT):
+            raise ValueError(
+                f"layer {i} is {c.layer_type}; heterogeneous staging "
+                "supports DENSE/OUTPUT layers")
+    dmax = max(max(c.n_in, c.n_out) for c in confs)
+
+    padded = []
+    for c, p in zip(confs, params):
+        w = jnp.zeros((dmax, dmax), p[WEIGHT_KEY].dtype)
+        w = w.at[: c.n_in, : c.n_out].set(p[WEIGHT_KEY])
+        b = jnp.zeros((dmax,), p[BIAS_KEY].dtype)
+        b = b.at[: c.n_out].set(p[BIAS_KEY])
+        padded.append({WEIGHT_KEY: w, BIAS_KEY: b})
+
+    def make_branch(c):
+        fwd = (output_layer.forward if c.layer_type == LayerType.OUTPUT
+               else dense_layer.forward)
+
+        def branch(p, x):
+            real = {WEIGHT_KEY: p[WEIGHT_KEY][: c.n_in, : c.n_out],
+                    BIAS_KEY: p[BIAS_KEY][: c.n_out]}
+            y = fwd(c, real, x[:, : c.n_in])
+            return jnp.pad(y, ((0, 0), (0, dmax - c.n_out)))
+
+        return branch
+
+    branches = [make_branch(c) for c in confs]
+
+    def stage_fn(p, x):
+        my = jax.lax.axis_index(axis)
+        return jax.lax.switch(my, branches, p, x)
+
+    stacked = shard_stage_params(stack_stage_params(padded), mesh, axis)
+    return stacked, stage_fn, confs[-1].n_out
+
+
 def make_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
                              mesh: Mesh, axis: str = PIPE_AXIS,
-                             lr: float = 0.1):
+                             lr: float = 0.1,
+                             batch_axis: "str | None" = None):
     """SGD train step over the pipelined stack.
 
     loss = mean over microbatches of ``loss_fn(y, labels_mb)`` on the
     pipeline output; gradients flow back through the tick schedule (reverse
     ppermute), so each stage's params receive exact gradients.
     step(stacked_params, x_mbs, y_mbs) -> (new_params, loss).
+    ``batch_axis`` composes dp×pp (see pipeline_apply); the loss mean then
+    spans the sharded microbatch dim, so GSPMD reduces it across the rows.
     """
 
     def loss_of(params, x_mbs, y_mbs):
-        outs = pipeline_apply(params, x_mbs, stage_fn, mesh, axis)
+        outs = pipeline_apply(params, x_mbs, stage_fn, mesh, axis,
+                              batch_axis=batch_axis)
         per = jax.vmap(loss_fn)(outs, y_mbs)
         return jnp.mean(per)
 

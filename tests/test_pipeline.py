@@ -162,3 +162,105 @@ def test_pipeline_from_conf_validates_stage_count():
     params = F.init_params(conf, jax.random.PRNGKey(0))
     with _pytest.raises(ValueError, match="pipe axis"):
         pipeline_from_conf(conf, params, _mesh())  # 2 dense != 4 devices
+
+
+# ---------------------------------------------------------------------------
+# Heterogeneous (non-uniform width) staging of real zoo models
+
+
+def test_heterogeneous_pipeline_zoo_forward_parity():
+    """digits_mlp (64→32→10, DENSE+OUTPUT) staged one-layer-per-device:
+    pipeline output == the sequential full-network forward."""
+    from deeplearning4j_tpu.models.zoo import digits_mlp
+    from deeplearning4j_tpu.nn import functional as F
+    from deeplearning4j_tpu.parallel.pipeline import (
+        heterogeneous_pipeline_from_conf,
+    )
+
+    conf = digits_mlp(hidden=32)
+    params = F.init_params(conf, jax.random.PRNGKey(0))
+    mesh = Mesh(np.array(jax.devices()[:2]), (PIPE_AXIS,))
+    stacked, stage_fn, out_w = heterogeneous_pipeline_from_conf(
+        conf, params, mesh)
+    assert out_w == 10
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (N_MICRO, MB, 64))
+    dmax = stacked["W"].shape[-1]
+    x_pad = jnp.pad(x, ((0, 0), (0, 0), (0, dmax - 64)))
+    out = pipeline_apply(stacked, x_pad, stage_fn, mesh)[..., :out_w]
+
+    ref = jax.vmap(lambda xb: F.output(conf, params, xb))(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+    # padding lanes carry exact zeros
+    assert float(jnp.max(jnp.abs(
+        pipeline_apply(stacked, x_pad, stage_fn, mesh)[..., out_w:]))) == 0.0
+
+
+def test_heterogeneous_pipeline_zoo_trains_with_parity():
+    """SGD through the staged digits_mlp matches the identical SGD on the
+    sequential model step-for-step (padded params receive zero grads)."""
+    from deeplearning4j_tpu.models.zoo import digits_mlp
+    from deeplearning4j_tpu.nn import functional as F
+    from deeplearning4j_tpu.parallel.pipeline import (
+        heterogeneous_pipeline_from_conf,
+    )
+
+    conf = digits_mlp(hidden=32)
+    params = F.init_params(conf, jax.random.PRNGKey(0))
+    mesh = Mesh(np.array(jax.devices()[:2]), (PIPE_AXIS,))
+    stacked, stage_fn, out_w = heterogeneous_pipeline_from_conf(
+        conf, params, mesh)
+    dmax = stacked["W"].shape[-1]
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (N_MICRO, MB, 64))
+    y = jax.nn.one_hot(
+        jax.random.randint(jax.random.PRNGKey(2), (N_MICRO, MB), 0, 10), 10)
+    x_pad = jnp.pad(x, ((0, 0), (0, 0), (0, dmax - 64)))
+    y_pad = jnp.pad(y, ((0, 0), (0, 0), (0, dmax - 10)))
+
+    eps = 1e-8
+
+    def loss_fn(probs, labels):  # MCXENT on the unpadded slice
+        return -jnp.mean(jnp.sum(
+            labels[..., :out_w] * jnp.log(probs[..., :out_w] + eps), -1))
+
+    lr = 0.5
+    step = make_pipeline_train_step(stage_fn, loss_fn, mesh, lr=lr)
+    jax.block_until_ready(pipeline_apply(stacked, x_pad, stage_fn, mesh))
+
+    # sequential twin: same forward, same loss, same SGD
+    def seq_loss(ps):
+        outs = jax.vmap(lambda xb: F.output(conf, ps, xb))(x)
+        return -jnp.mean(jnp.sum(y * jnp.log(outs + eps), -1))
+
+    seq_params = params
+    losses_pipe, losses_seq = [], []
+    for _ in range(5):
+        stacked, lp = step(stacked, x_pad, y_pad)
+        jax.block_until_ready(lp)
+        ls, gs = jax.value_and_grad(seq_loss)(seq_params)
+        seq_params = jax.tree_util.tree_map(
+            lambda p, g: p - lr * g, seq_params, gs)
+        losses_pipe.append(float(lp))
+        losses_seq.append(float(ls))
+    np.testing.assert_allclose(losses_pipe, losses_seq, atol=1e-5, rtol=1e-5)
+    assert losses_pipe[-1] < losses_pipe[0]
+
+
+def test_heterogeneous_pipeline_validation():
+    from deeplearning4j_tpu.models.zoo import digits_mlp, lenet
+    from deeplearning4j_tpu.nn import functional as F
+    from deeplearning4j_tpu.parallel.pipeline import (
+        heterogeneous_pipeline_from_conf,
+    )
+
+    conf = digits_mlp(hidden=32)
+    params = F.init_params(conf, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="pipe axis"):
+        heterogeneous_pipeline_from_conf(conf, params, _mesh())  # 2 != 4
+    lconf = lenet()
+    lparams = F.init_params(lconf, jax.random.PRNGKey(0))
+    mesh7 = Mesh(np.array(jax.devices()[:7]), (PIPE_AXIS,))
+    with pytest.raises(ValueError, match="DENSE/OUTPUT"):
+        heterogeneous_pipeline_from_conf(lconf, lparams, mesh7)
