@@ -101,13 +101,18 @@ def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool):
 
 
 def ring_attention(q: Array, k: Array, v: Array, mesh: Mesh, axis: str,
-                   causal: bool = False) -> Array:
+                   causal: bool = False,
+                   batch_axis: Optional[str] = None) -> Array:
     """Multi-head attention with the SEQUENCE axis sharded over ``axis``.
 
     q/k/v: (B, H, T, D) global arrays (T divisible by the axis size).
     Returns (B, H, T, D) with the same sharding.
+
+    ``batch_axis`` composes dp×sp on a 2-D mesh: the batch dim is sharded
+    over that axis, so each data-parallel row runs its own K/V ring over
+    ``axis`` — the composed-mesh path used by models/transformer_lm.py.
     """
-    spec = P(None, None, axis, None)
+    spec = P(batch_axis, None, axis, None)
     fn = partial(_ring_attention_sharded, axis_name=axis, causal=causal)
     sharded = jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
