@@ -143,6 +143,17 @@ fused_dense.defvjp(_fused_dense_fwd, _fused_dense_bwd)
 
 # ------------------------------------------------------------- lstm gates ----
 
+# A/B switch for the fused-gate kernel (None = auto by shape): the bench
+# quantifies the kernel's value by running the same LSTM stage with the
+# kernel forced off (set_lstm_gates(False) → plain lax gate math).
+_lstm_gates_override: "bool | None" = None
+
+
+def set_lstm_gates(enabled: "bool | None") -> None:
+    global _lstm_gates_override
+    _lstm_gates_override = enabled
+
+
 def _lstm_gates_kernel(ifog_ref, c_ref, c_out_ref, h_out_ref):
     """(B, 4H) fused preactivations + (B, H) c_prev -> c_new, h_new.
     Gate order i,f,o,g (ref LSTM.java iFog layout).
@@ -204,7 +215,10 @@ def lstm_gates(ifog: Array, c_prev: Array):
     """Fused LSTM cell nonlinearity: (c_new, h_new) from (B,4H) + (B,H)."""
     h = c_prev.shape[-1]
     # h bound keeps the (tile_b, 7h) working set inside VMEM
-    if h % 128 == 0 and ifog.shape[0] % 8 == 0 and h <= 2048:
+    use_pallas = (h % 128 == 0 and ifog.shape[0] % 8 == 0 and h <= 2048)
+    if _lstm_gates_override is not None:
+        use_pallas = _lstm_gates_override and use_pallas
+    if use_pallas:
         return _lstm_gates_pallas(ifog, c_prev)
     return _lstm_gates_ref(ifog, c_prev)
 
