@@ -34,6 +34,42 @@ def test_conv_output_shape():
     assert out.shape == (4, 6, 24, 24)  # VALID 5x5 conv
 
 
+def test_conv_emitter_matches_im2col():
+    """conv2d (the lax.conv emitter core, round-5 switch) must match the
+    legacy im2col formulation in forward AND both gradients — im2col is the
+    pads-and-matmuls parity oracle."""
+    import numpy as np
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (8, 3, 5, 5)) * 0.1
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 3, 12, 12))
+
+    convolution.set_conv_emitter(True)  # (3*5*5=75 would auto-gate to im2col)
+    try:
+        np.testing.assert_allclose(
+            np.asarray(convolution.conv2d(x, w)),
+            np.asarray(convolution.im2col_conv(x, w)), atol=1e-5)
+
+        def loss_emitter(w, x):
+            return jnp.sum(convolution.conv2d(x, w) ** 2)
+
+        def loss_im2col(w, x):
+            return jnp.sum(convolution.im2col_conv(x, w) ** 2)
+
+        gw_e, gx_e = jax.grad(loss_emitter, argnums=(0, 1))(w, x)
+        gw_i, gx_i = jax.grad(loss_im2col, argnums=(0, 1))(w, x)
+        np.testing.assert_allclose(np.asarray(gw_e), np.asarray(gw_i),
+                                   atol=1e-4)
+        np.testing.assert_allclose(np.asarray(gx_e), np.asarray(gx_i),
+                                   atol=1e-4)
+    finally:
+        convolution.set_conv_emitter(None)
+
+    # auto gate: narrow contraction routes to the im2col core exactly
+    np.testing.assert_array_equal(
+        np.asarray(convolution.conv2d(x, w)),
+        np.asarray(convolution.im2col_conv(x, w)))
+
+
 def test_subsampling_max_pool():
     conf = NeuralNetConfiguration(layer_type="SUBSAMPLING", stride=(2, 2),
                                   convolution_type=ConvolutionType.MAX)
