@@ -97,6 +97,82 @@ def test_word2vec_sgns_learns_topics():
     assert len(tech_words & set(nearest)) >= 3, nearest
 
 
+def test_word2vec_classic_per_pair_negatives_learns():
+    """shared_negatives=0 keeps the reference's per-pair draws
+    (Word2Vec.java:303-342) as a selectable path — quality-equivalent to
+    the default shared-group path on the topic corpus."""
+    vec = Word2Vec(
+        sentence_iterator=CollectionSentenceIterator(_toy_corpus()),
+        layer_size=16, window=3, negative=5, iterations=10,
+        lr=0.1, sample=0, batch_size=128, seed=1, shared_negatives=0,
+    )
+    vec.fit()
+    same = vec.similarity("apple", "banana")
+    cross = vec.similarity("apple", "gpu")
+    assert same > cross, (same, cross)
+
+
+def test_shared_negative_group_divides_step():
+    """The production group-size selection always divides the step's pair
+    count, whatever batch_size/window imply (falls back to 1 — per-pair
+    semantics — when the pair count is prime)."""
+    from deeplearning4j_tpu.models.word2vec import neg_group_size
+
+    for batch_size, window, cap in [(2048, 5, 25), (100, 3, 25),
+                                    (7, 1, 25), (8192, 5, 25),
+                                    (65536, 5, 25)]:
+        block = max(-(-batch_size // (2 * window)), 1)
+        bsz = block * 2 * window
+        g = neg_group_size(bsz, cap)
+        assert bsz % g == 0 and 1 <= g <= cap
+    assert neg_group_size(7, 25) == 7   # bsz below cap: whole step one group
+    assert neg_group_size(13, 5) == 1   # prime above cap: per-pair
+
+
+def test_lookup_table_readable_after_failed_fit(monkeypatch):
+    """A fit() that dies mid-epoch must leave the model READABLE: the host
+    table (content as of the last sync/upload) becomes authoritative and
+    later reads never crash on a half-donated device state."""
+    import deeplearning4j_tpu.models.word2vec as w2v_mod
+
+    vec = Word2Vec(
+        sentence_iterator=CollectionSentenceIterator(_toy_corpus()),
+        layer_size=8, window=2, negative=2, iterations=1,
+        lr=0.1, sample=0, batch_size=64, seed=1,
+    )
+    vec.fit()
+    _ = vec.word_vector("apple")  # sync once so the host has trained values
+    host_before = np.array(vec.lookup_table.syn0)
+
+    def boom(*a, **k):
+        raise RuntimeError("injected epoch failure")
+
+    monkeypatch.setattr(w2v_mod, "_sgns_device_epoch", boom)
+    with pytest.raises(RuntimeError, match="injected"):
+        vec.fit()
+    v = vec.word_vector("apple")  # must not raise
+    assert v is not None
+    np.testing.assert_allclose(np.asarray(vec.lookup_table.syn0),
+                               host_before)
+
+
+def test_stale_host_table_rejects_inplace_writes():
+    """After a fit, in-place writes through a retained host-table reference
+    fail loudly (the arrays are frozen/read-only) instead of silently
+    shadowing the device-side training; wholesale re-assignment remains the
+    supported edit path."""
+    vec = Word2Vec(
+        sentence_iterator=CollectionSentenceIterator(_toy_corpus()),
+        layer_size=8, window=2, negative=2, iterations=1,
+        lr=0.1, sample=0, batch_size=64, seed=1,
+    )
+    vec.build_vocab()
+    retained = vec._lookup_table  # grabbed before training, bypasses sync
+    vec.fit()
+    with pytest.raises(ValueError):
+        retained.syn0[0, 0] = 123.0
+
+
 def test_word2vec_hierarchical_softmax_learns():
     vec = Word2Vec(
         sentence_iterator=CollectionSentenceIterator(_toy_corpus()),
