@@ -15,7 +15,7 @@ LABELED synthetic — they are convergence proofs for the 784-input configs,
 never claimed as real-data accuracy. Real-MNIST gates are recorded as
 ``pending`` with the reason.
 
-Run:  python accuracy_gates.py  →  prints JSON and writes ACCURACY_r04.json
+Run:  python accuracy_gates.py  →  prints JSON and writes ACCURACY_r05.json
 """
 
 from __future__ import annotations
@@ -243,7 +243,7 @@ def main() -> None:
         ],
         "all_passed": all(g["passed"] for g in gates),
     }
-    with open("ACCURACY_r04.json", "w") as f:
+    with open("ACCURACY_r05.json", "w") as f:
         json.dump(out, f, indent=2)
     print(json.dumps(out))
 
