@@ -273,3 +273,29 @@ def make_pp_stages(params: dict, n_heads: int, top_k: int = 2):
         return jax.lax.switch(my, [attn_stage, moe_stage], p, x)
 
     return [stage0, stage1], stage_fn
+
+
+def make_pp_loss(stage_fn, mesh: Mesh, pipe_axis: str,
+                 batch_axis: Optional[str] = None):
+    """Staged-LM task loss for the dp×pp path — embed lookup, the pipeline
+    schedule over ``pipe_axis``, decoder, mean NLL. The dense twin is
+    ``dense_loss_fn(n_heads, aux_weight=0.0)`` on the flattened
+    microbatches (aux is a router-training regularizer, orthogonal to
+    pipeline parity). Shared by tests/test_composed.py and the driver's
+    dryrun gate so the two can never drift apart.
+
+    loss(trained, toks_mbs, targets_mbs) where trained = (stacked_stage_
+    params, embed, dec_w, dec_b) and toks/targets are (n_micro, mb, T)."""
+    from deeplearning4j_tpu.parallel.pipeline import pipeline_apply
+
+    def loss(trained, toks_mbs, tgt_mbs):
+        stacked, embed, dec_w, dec_b = trained
+        x_mbs = embed[toks_mbs]  # (M, mb, T, d)
+        outs = pipeline_apply(stacked, x_mbs, stage_fn, mesh, pipe_axis,
+                              batch_axis=batch_axis)
+        logits = outs @ dec_w + dec_b
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, tgt_mbs[..., None], -1)[..., 0]
+        return jnp.mean(nll)
+
+    return loss

@@ -13,6 +13,7 @@ from deeplearning4j_tpu.models.transformer_lm import (
     dense_loss_fn,
     init_lm_params,
     make_composed_train_step,
+    make_pp_loss,
     make_pp_stages,
     make_single_device_train_step,
     shard_lm_batch,
@@ -117,7 +118,6 @@ def test_dp_pp_trains_with_parity():
     stages on "pipe" with microbatches sharded over "data" — the SGD loss
     trajectory matches the unstaged dense model step-for-step."""
     from deeplearning4j_tpu.parallel.pipeline import (
-        pipeline_apply,
         shard_stage_params,
         stack_stage_params,
     )
@@ -133,15 +133,7 @@ def test_dp_pp_trains_with_parity():
                               (n_micro, mb, T + 1), 0, V)
     toks_mbs, tgt_mbs = toks[..., :-1], toks[..., 1:]
 
-    def pipe_loss(trained, toks_mbs, tgt_mbs):
-        stacked, embed, dec_w, dec_b = trained
-        x_mbs = embed[toks_mbs]  # (M, mb, T, d)
-        outs = pipeline_apply(stacked, x_mbs, stage_fn, mesh, "pipe",
-                              batch_axis="data")
-        logits = outs @ dec_w + dec_b
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, tgt_mbs[..., None], -1)[..., 0]
-        return jnp.mean(nll)
+    pipe_loss = make_pp_loss(stage_fn, mesh, "pipe", batch_axis="data")
 
     # dense twin: identical math, no staging, no aux (the pp path's task
     # loss only — aux is a router-training regularizer, orthogonal here)
