@@ -170,6 +170,41 @@ def test_two_process_training_converges(router_cls):
     assert ev.accuracy() > 0.6, ev.accuracy()
 
 
+def test_early_stopping_trips_across_processes():
+    """The SAME EarlyStopping policy the in-process runner uses stops a
+    cross-process run: workers publish non-improving scores over TCP, the
+    master's patience trips tracker.early_stop(), the run ends before the
+    job iterator drains, and the workers' poll loops see the flag and
+    exit cleanly (ref: StateTracker earlyStop/bestLoss flags,
+    BaseHazelCastStateTracker)."""
+    from deeplearning4j_tpu.scaleout.runner import EarlyStopping
+
+    items = [7.0] * 400  # constant |work| -> constant scores, no improvement
+    master = DistributedMaster(
+        job_iterator=CollectionJobIterator(items),
+        min_workers=2, max_rounds=200, register_timeout_s=120,
+        early_stopping=EarlyStopping(patience=2),
+    )
+    master.router = HogWildWorkRouter(master.tracker,
+                                      ParameterAveragingAggregator())
+    procs = [
+        _spawn_worker(master.address, "_dist_helpers:averaging_performer",
+                      worker_id=f"w{i}")
+        for i in range(2)
+    ]
+    try:
+        params = master.train()
+    finally:
+        outs = _finish(procs, master)
+    assert master.tracker.is_early_stop(), outs
+    done = master.tracker.count("jobs_done")
+    assert done < len(items), f"early stop never tripped ({done} jobs ran)"
+    assert params is not None
+    # workers exited on the flag, not by being killed
+    for p in procs:
+        assert p.returncode == 0, (p.returncode, outs)
+
+
 def test_worker_process_crash_is_recovered():
     """One worker hard-crashes (os._exit mid-perform, no cleanup): the
     master's heartbeat watchdog requeues its job onto the survivor and the
