@@ -577,6 +577,14 @@ def main() -> None:
     w2vl_cpu = detail.get("cpu_word2vec_large_words_per_sec")
     if w2vl_tpu and w2vl_cpu:
         detail["word2vec_large_vs_cpu"] = round(w2vl_tpu / w2vl_cpu, 2)
+    detail["attn_note"] = (
+        "attn_bf16 (T=64, d=256) is the r04-continuity stage and is "
+        "model-bound at that sequence length (the score matmuls are 64x64; "
+        "the dense core is correct there — blockwise dispatch starts at "
+        "T>=1024). attn_long_bf16 (T=2048, d_model=512) is the "
+        "representative long-context stage: blockwise core, O(T) temps "
+        "(see attn_long_bf16_detail), with the _densecore twin as the A/B."
+    )
     detail["word2vec_note"] = (
         "r05 attribution (on-chip ablations, models/word2vec.py): scatter-"
         "adds were 67-69% of the r04 SGNS epoch at both scales, row-"
