@@ -94,38 +94,13 @@ def _sgns_update_shared(syn0, syn1neg, ctr, ctx, wmat, negs_g, lr):
     the SUM of its gradient contributions by the total contributing weight
     (a shared negative row's count is its group's total pair weight)."""
     block, two_w = ctx.shape
-    b = block * two_w
-    g, k = negs_g.shape
-    p = b // g
     vb = syn0[ctr]                          # (block,D) — the only c-gather
     v = jnp.repeat(vb, two_w, axis=0)       # (B,D) broadcast
     contexts = ctx.reshape(-1)
     weights = wmat.reshape(-1)
-    u_pos = syn1neg[contexts]               # (B,D)
-    u_neg = syn1neg[negs_g]                 # (G,K,D)
-    vg = v.reshape(g, p, -1)
-    wg = weights.reshape(g, p)
-
-    pos_score = jax.nn.sigmoid(jnp.sum(v * u_pos, axis=-1))          # (B,)
-    neg_score = jax.nn.sigmoid(jnp.einsum("gpd,gkd->gpk", vg, u_neg))
-
-    g_pos = (pos_score - 1.0) * weights                              # (B,)
-    g_neg = neg_score * wg[..., None]                                # (G,P,K)
-
-    grad_v = (g_pos[:, None] * u_pos
-              + jnp.einsum("gpk,gkd->gpd", g_neg, u_neg).reshape(b, -1))
-    grad_u_pos = g_pos[:, None] * v
-    grad_u_neg = jnp.einsum("gpk,gpd->gkd", g_neg, vg)               # (G,K,D)
-
-    u_idx = jnp.concatenate([contexts, negs_g.reshape(-1)])
-    u_grad = jnp.concatenate([grad_u_pos, grad_u_neg.reshape(g * k, -1)])
-    u_w = jnp.concatenate([
-        weights,
-        jnp.broadcast_to(wg.sum(1)[:, None], (g, k)).reshape(-1),
-    ])
-    eps = 1e-7
-    loss = -(jnp.log(pos_score + eps) * weights).sum() - (
-        jnp.log(1.0 - neg_score + eps) * wg[..., None]).sum()
+    centers = jnp.repeat(ctr, two_w)        # for the shared-grads contract
+    grad_v, u_idx, u_grad, u_w, loss = _sgns_grads_shared(
+        syn0, syn1neg, centers, contexts, weights, negs_g, v=v)
 
     wrow = wmat.sum(1)                                               # (block,)
     c_cnt = jnp.zeros(syn0.shape[0], syn0.dtype).at[ctr].add(wrow)
@@ -347,7 +322,51 @@ def _sgns_grads(syn0, syn1neg, centers, contexts, weights, negs):
     return grad_v, u_idx, u_grad, u_w, loss
 
 
-def make_sharded_sgns_step(mesh, negative: int):
+def _sgns_grads_shared(syn0, syn1neg, centers, contexts, weights, negs_g,
+                       v=None):
+    """Group-shared-negative twin of ``_sgns_grads`` (same return contract:
+    grad_v, u_idx, u_grad, u_w, loss) for flat (B,) pairs with negs_g (G,K)
+    shared per group of P = B/G pairs — the negative gradients become
+    per-group matmuls and the u row count drops from B*(1+K) to B + G*K.
+
+    ``v``: optional precomputed (B,D) center rows — the window-reduced
+    caller (_sgns_update_shared) passes a (block,)-row gather broadcast
+    over the window instead of a per-pair gather; omitted, the rows are
+    gathered per pair (arbitrary pair streams, e.g. the sharded step)."""
+    b = centers.shape[0]
+    g, k = negs_g.shape
+    p = b // g
+    if v is None:
+        v = syn0[centers]                   # (B,D)
+    u_pos = syn1neg[contexts]               # (B,D)
+    u_neg = syn1neg[negs_g]                 # (G,K,D)
+    vg = v.reshape(g, p, -1)
+    wg = weights.reshape(g, p)
+
+    pos_score = jax.nn.sigmoid(jnp.sum(v * u_pos, axis=-1))          # (B,)
+    neg_score = jax.nn.sigmoid(jnp.einsum("gpd,gkd->gpk", vg, u_neg))
+
+    g_pos = (pos_score - 1.0) * weights                              # (B,)
+    g_neg = neg_score * wg[..., None]                                # (G,P,K)
+
+    grad_v = (g_pos[:, None] * u_pos
+              + jnp.einsum("gpk,gkd->gpd", g_neg, u_neg).reshape(b, -1))
+    grad_u_pos = g_pos[:, None] * v
+    grad_u_neg = jnp.einsum("gpk,gpd->gkd", g_neg, vg)               # (G,K,D)
+
+    u_idx = jnp.concatenate([contexts, negs_g.reshape(-1)])
+    u_grad = jnp.concatenate([grad_u_pos, grad_u_neg.reshape(g * k, -1)])
+    u_w = jnp.concatenate([
+        weights,
+        jnp.broadcast_to(wg.sum(1)[:, None], (g, k)).reshape(-1),
+    ])
+    eps = 1e-7
+    loss = -(jnp.log(pos_score + eps) * weights).sum() - (
+        jnp.log(1.0 - neg_score + eps) * wg[..., None]).sum()
+    return grad_v, u_idx, u_grad, u_w, loss
+
+
+def make_sharded_sgns_step(mesh, negative: int, neg_group: int = 0):
     """Data-parallel SGNS step over a device mesh.
 
     The pair stream is sharded on the mesh's data axis; each shard computes
@@ -355,6 +374,11 @@ def make_sharded_sgns_step(mesh, negative: int):
     AllReduces them over ICI, and every device applies the identical
     collision-normalized update — numerically the single-device ``_sgns_step``
     on the concatenated global batch (negatives are drawn per-shard).
+
+    ``neg_group``: pairs per shared-negative group WITHIN each shard (must
+    divide the per-shard pair count; 0 = classic per-pair draws) — the same
+    scatter-row lever as the single-device epoch (_sgns_update_shared),
+    applied to each shard's local gradient build before the psum.
 
     Replaces the reference's host-side delta-merging aggregation
     (ref: scaleout/perform/models/word2vec/Word2VecPerformer.java + spark
@@ -367,9 +391,16 @@ def make_sharded_sgns_step(mesh, negative: int):
     def step(syn0, syn1neg, centers, contexts, weights, neg_table, lr, key):
         shard = jax.lax.axis_index(DATA_AXIS)
         key = jax.random.fold_in(key, shard)
-        negs = _sample_negs(key, neg_table, centers.shape[0], negative)
-        grad_v, u_idx, u_grad, u_w, loss = _sgns_grads(
-            syn0, syn1neg, centers, contexts, weights, negs)
+        b_local = centers.shape[0]
+        if neg_group:
+            negs_g = _sample_negs(key, neg_table, b_local // neg_group,
+                                  negative)
+            grad_v, u_idx, u_grad, u_w, loss = _sgns_grads_shared(
+                syn0, syn1neg, centers, contexts, weights, negs_g)
+        else:
+            negs = _sample_negs(key, neg_table, b_local, negative)
+            grad_v, u_idx, u_grad, u_w, loss = _sgns_grads(
+                syn0, syn1neg, centers, contexts, weights, negs)
         g0 = jnp.zeros_like(syn0).at[centers].add(grad_v)
         c0 = jnp.zeros(syn0.shape[0], syn0.dtype).at[centers].add(weights)
         g1 = jnp.zeros_like(syn1neg).at[u_idx].add(u_grad)
@@ -871,7 +902,14 @@ class Word2Vec:
         (make_sharded_sgns_step). The host pair stream stays here because
         shard_map needs explicitly sharded batch inputs."""
         rng = np.random.default_rng(self.seed)
-        sgns_step = make_sharded_sgns_step(self.mesh, self.negative)
+        from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
+
+        b_local = self.batch_size // self.mesh.shape[DATA_AXIS]
+        ng = (neg_group_size(b_local, self.shared_negatives)
+              if (self.shared_negatives and self.negative > 0 and b_local)
+              else 0)
+        sgns_step = make_sharded_sgns_step(self.mesh, self.negative,
+                                           neg_group=ng)
         hs_step = make_sharded_hs_step(self.mesh)
         neg_table = self._neg_table() if self.negative > 0 else None
         if self.use_hs:

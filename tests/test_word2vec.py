@@ -129,6 +129,37 @@ def test_shared_negative_group_divides_step():
     assert neg_group_size(13, 5) == 1   # prime above cap: per-pair
 
 
+def test_shared_grads_reduce_to_per_pair_at_group_one():
+    """_sgns_grads_shared with one pair per group (negs_g: (B,K)) must be
+    EXACTLY the per-pair _sgns_grads — the shared path is a strict
+    generalization, so the sharded step's neg_group feature never changes
+    semantics at the degenerate group size."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.word2vec import (
+        _sgns_grads,
+        _sgns_grads_shared,
+    )
+
+    V, D, B, K = 50, 8, 12, 3
+    ks = jax.random.split(jax.random.PRNGKey(0), 5)
+    syn0 = jax.random.normal(ks[0], (V, D))
+    syn1neg = jax.random.normal(ks[1], (V, D)) * 0.1
+    centers = jax.random.randint(ks[2], (B,), 0, V)
+    contexts = jax.random.randint(ks[3], (B,), 0, V)
+    weights = jnp.asarray([1.0] * 10 + [0.0] * 2)  # incl. padding mask
+    negs = jax.random.randint(ks[4], (B, K), 0, V)
+
+    ref = _sgns_grads(syn0, syn1neg, centers, contexts, weights, negs)
+    shared = _sgns_grads_shared(syn0, syn1neg, centers, contexts, weights,
+                                negs)
+    for a, b, name in zip(ref, shared,
+                          ("grad_v", "u_idx", "u_grad", "u_w", "loss")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6,
+                                   err_msg=name)
+
+
 def test_lookup_table_readable_after_failed_fit(monkeypatch):
     """A fit() that dies mid-epoch must leave the model READABLE: the host
     table (content as of the last sync/upload) becomes authoritative and
