@@ -1,5 +1,7 @@
 """Benchmarks: MNIST MLP + LeNet + wide-conv + char-LSTM + Word2Vec
-(BASELINE configs #1/#2/#4 plus MXU-fill diagnostics).
+(BASELINE configs #1/#2/#4 plus MXU-fill diagnostics) + the composed
+transformer-LM flagship (lm_composed: multi-block, blockwise flash core via
+the DL4J_TPU_ATTN_IMPL seam, with forced-dense and forced-CPU twins).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
 
@@ -121,6 +123,26 @@ ATTN_LONG_FWD_FLOPS = (
                          + 4 * ATTN_LONG_D * ATTN_LONG_D)
     + 4 * ATTN_LONG_SEQ * ATTN_LONG_SEQ * ATTN_LONG_D
 )
+# COMPOSED-flagship LM (round 6): the multi-block transformer LM
+# (models/transformer_lm.py — n_layers scan-stacked decoder blocks of
+# causal MHA + top-2 MoE FFN) trained END TO END on one chip, attention
+# core selected through the DL4J_TPU_ATTN_IMPL env seam (blockwise flash
+# for the main stage, the materializing dense core for the _densecore A/B
+# twin, and the same blockwise stage in a forced-CPU child as baseline).
+# FLOPs per sample: per layer the q/k/v/o projections, the FULL T² score
+# rectangle (same accounting convention as attn_long — the blockwise core
+# executes only the causal half but its backward recomputes block scores,
+# the two roughly cancel), the router matmul, and dense_moe which runs ALL
+# E experts on every token (that is what executes on one chip — the
+# expert-parallel capacity path needs the mesh); plus the vocab decoder.
+LMC_VOCAB, LMC_D, LMC_HEADS, LMC_EXPERTS, LMC_DFF = 2048, 512, 4, 4, 1024
+LMC_LAYERS, LMC_SEQ, LMC_BATCH = 2, 2048, 4
+LMC_FWD_FLOPS = LMC_LAYERS * (
+    2 * LMC_SEQ * 4 * LMC_D * LMC_D
+    + 4 * LMC_SEQ * LMC_SEQ * LMC_D
+    + 2 * LMC_SEQ * LMC_D * LMC_EXPERTS
+    + LMC_EXPERTS * 2 * LMC_SEQ * 2 * LMC_D * LMC_DFF
+) + 2 * LMC_SEQ * LMC_D * LMC_VOCAB
 TRAIN_FLOPS = {
     "mlp": 3 * MLP_FWD_FLOPS,
     "lenet": 3 * LENET_FWD_FLOPS,
@@ -129,6 +151,7 @@ TRAIN_FLOPS = {
     "lstm_wide": 3 * LSTM_WIDE_FWD_FLOPS,
     "attn": 3 * ATTN_FWD_FLOPS,
     "attn_long": 3 * ATTN_LONG_FWD_FLOPS,
+    "lm_composed": 3 * LMC_FWD_FLOPS,
 }
 
 # Per-model batch/chunk: the wide conv's im2col buffers and the LSTM's
@@ -337,6 +360,77 @@ def measure_word2vec(n_sentences: int = 2000, sent_len: int = 100,
     return rate
 
 
+def measure_lm_composed(steps: int | None = None,
+                        batch: int | None = None) -> float:
+    """End-to-end training samples/sec of the COMPOSED-flagship LM: the
+    multi-block (n_layers=2) transformer LM with causal MHA + top-2 MoE
+    FFN, trained by models/transformer_lm.make_single_device_train_step.
+
+    The attention core comes from the DL4J_TPU_ATTN_IMPL env seam —
+    run_stage exports it BEFORE tracing ("blockwise" for the main stage and
+    the forced-CPU baseline, "dense" for the _densecore A/B twin), so the
+    A/B needs no code edits. Same timing discipline as ``measure``: warmup,
+    measured fetch latency, run length doubled until a timed run dwarfs the
+    tunnel jitter, median of 3."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.transformer_lm import (
+        init_lm_params,
+        make_single_device_train_step,
+    )
+
+    repeats = 3
+    if _fast():
+        vocab, d, heads, experts, dff = 256, 64, 2, 2, 128
+        seq = 256
+    else:
+        vocab, d, heads, experts, dff = (LMC_VOCAB, LMC_D, LMC_HEADS,
+                                         LMC_EXPERTS, LMC_DFF)
+        seq = LMC_SEQ
+    batch = batch if batch is not None else (2 if _fast() else LMC_BATCH)
+
+    params = init_lm_params(jax.random.PRNGKey(0), vocab, d, heads, experts,
+                            dff, n_layers=LMC_LAYERS)
+    step = make_single_device_train_step(heads)
+    toks = jax.random.randint(jax.random.PRNGKey(2), (batch, seq + 1), 0,
+                              vocab)
+    tk, tg = toks[:, :-1], toks[:, 1:]
+    zero = jnp.asarray(0)
+    float(jnp.sum(tk) + jnp.sum(tg) + zero)  # force + sync the transfers
+
+    def run(k):
+        nonlocal params
+        t0 = time.perf_counter()
+        for _ in range(k):
+            params, loss = step(params, tk, tg)
+        last = float(loss)  # true sync: device->host fetch
+        assert math.isfinite(last), "non-finite lm_composed loss"
+        return time.perf_counter() - t0
+
+    for _ in range(2):
+        run(1)  # compile + warmup
+
+    fetch_lat = statistics.median(
+        _time_of(lambda: float(jnp.sum(zero + 1))) for _ in range(5)
+    )
+    target = 0.3 if _fast() else 1.2
+    k = max(steps, 1) if steps is not None else 1
+    t = run(k)
+    while t < target + fetch_lat and k < 256:
+        k *= 2
+        t = run(k)
+    times = [t] + [run(k) for _ in range(repeats - 1)]
+    t_med = statistics.median(times)
+    rate = k * batch / max(t_med - fetch_lat, 0.2 * t_med)
+    print("STAGE_DETAIL " + json.dumps({
+        "tokens_per_sec": round(rate * seq, 1),
+        "seq_len": seq, "n_layers": LMC_LAYERS,
+        "attn_impl": os.environ.get("DL4J_TPU_ATTN_IMPL", "auto"),
+    }), flush=True)
+    return rate
+
+
 def mfu(model: str, samples_per_sec: float, precision: str) -> float:
     return (samples_per_sec * TRAIN_FLOPS[model]
             / PRECISION_PEAKS.get(precision, PEAK_BF16_FLOPS))
@@ -352,7 +446,11 @@ def _fast() -> bool:
 
 def _split_stage(name: str) -> tuple:
     """'conv_wide_bf16' → ('conv', 'bf16'); 'mlp_fp32_true' → ('mlp',
-    'fp32_true'); 'attn_long_bf16[_densecore]' → ('attn_long', 'bf16')."""
+    'fp32_true'); 'attn_long_bf16[_densecore]' → ('attn_long', 'bf16');
+    'lm_composed[_densecore]' → ('lm_composed', 'fp32')."""
+    if name.startswith("lm_composed"):
+        # the flagship LM runs f32 params at DEFAULT matmul precision
+        return "lm_composed", "fp32"
     if name.startswith("conv_wide_"):
         precision = name[len("conv_wide_"):]
         if precision.endswith("_im2col"):
@@ -400,11 +498,24 @@ def _attn_long_memory_detail() -> dict:
 
 def run_stage(name: str) -> float:
     steps = 2 * CHUNK if _fast() else None
-    if name in ("cpu_mlp_fp32", "cpu_word2vec", "cpu_word2vec_large"):
+    if name in ("cpu_mlp_fp32", "cpu_word2vec", "cpu_word2vec_large",
+                "cpu_lm_composed"):
         if name == "cpu_mlp_fp32":
             return measure("mlp", "fp32", steps=CHUNK,
                            batch=64 if _fast() else None)
         name = name[len("cpu_"):]
+        if name == "lm_composed":
+            # forced-CPU baseline: SAME stage, blockwise core, tiny batch
+            # (a CPU full-shape step is seconds — per-sample rate is what
+            # the vs_cpu ratio needs)
+            os.environ["DL4J_TPU_ATTN_IMPL"] = "blockwise"
+            return measure_lm_composed(batch=None if _fast() else 1)
+    if name.startswith("lm_composed"):
+        # the env seam (not set_attention_impl) on purpose: proves the
+        # no-code-edit switch the driver's dryrun can use too
+        os.environ["DL4J_TPU_ATTN_IMPL"] = (
+            "dense" if name.endswith("_densecore") else "blockwise")
+        return measure_lm_composed()
     if name == "word2vec":
         if _fast():
             return measure_word2vec(n_sentences=100, sent_len=20, vocab=200)
@@ -482,6 +593,9 @@ STAGES = [
     ("attn_bf16", 170),
     ("attn_long_bf16", 220),
     ("attn_long_bf16_densecore", 170),
+    ("cpu_lm_composed", 280),
+    ("lm_composed", 280),
+    ("lm_composed_densecore", 240),
     ("cpu_word2vec", 150),
     ("word2vec", 120),
     ("cpu_word2vec_large", 300),
@@ -537,7 +651,14 @@ def main() -> None:
         ),
     }
 
+    # BENCH_ONLY="a,b" runs just those stages through the same budget/
+    # subprocess discipline — how test_bench_smoke guards a new stage
+    # without paying for the whole suite
+    only = [s.strip() for s in os.environ.get("BENCH_ONLY", "").split(",")
+            if s.strip()]
     for stage, cap in STAGES:
+        if only and stage not in only:
+            continue
         if "word2vec" in stage:
             key = f"{stage}_words_per_sec"
         else:
@@ -577,6 +698,23 @@ def main() -> None:
     w2vl_cpu = detail.get("cpu_word2vec_large_words_per_sec")
     if w2vl_tpu and w2vl_cpu:
         detail["word2vec_large_vs_cpu"] = round(w2vl_tpu / w2vl_cpu, 2)
+    lmc = detail.get("lm_composed_samples_per_sec")
+    lmc_dense = detail.get("lm_composed_densecore_samples_per_sec")
+    if lmc and lmc_dense:
+        detail["lm_composed_vs_densecore"] = round(lmc / lmc_dense, 2)
+    lmc_cpu = detail.get("cpu_lm_composed_samples_per_sec")
+    if lmc and lmc_cpu:
+        detail["lm_composed_vs_cpu"] = round(lmc / lmc_cpu, 2)
+    detail["lm_composed_note"] = (
+        "lm_composed = the multi-block (n_layers=2) transformer-LM "
+        "flagship (causal MHA + top-2 MoE FFN, T=2048, d_model=512, "
+        "V=2048, E=4 dense experts) trained end to end on one chip with "
+        "the blockwise flash core forced via DL4J_TPU_ATTN_IMPL; "
+        "_densecore is the same stage with the (T,T)-materializing core; "
+        "cpu_lm_composed is the same blockwise stage in a forced-CPU "
+        "child (batch=1). MFU is vs the fp32-DEFAULT peak; dense_moe "
+        "executes all E experts per token and the FLOP model counts that."
+    )
     detail["attn_note"] = (
         "attn_bf16 (T=64, d=256) is the r04-continuity stage and is "
         "model-bound at that sequence length (the score matmuls are 64x64; "

@@ -1,0 +1,46 @@
+"""Version seam for the ambient JAX.
+
+The codebase is written against the current jax API surface
+(``jax.shard_map`` with ``check_vma``, the ``jax_num_cpu_devices`` config
+flag); older jaxlibs (0.4.x) ship the same functionality as
+``jax.experimental.shard_map`` with ``check_rep`` and the
+``--xla_force_host_platform_device_count`` XLA flag. Every call site goes
+through this module so the rest of the tree can stay written against the
+modern names.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["shard_map", "set_host_device_count"]
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False):
+    """``jax.shard_map`` where available, else the 0.4.x experimental one
+    (same semantics; ``check_vma`` was called ``check_rep`` there)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=check_vma)
+
+
+def set_host_device_count(n: int) -> None:
+    """Make the CPU platform expose ``n`` devices.
+
+    Must run BEFORE the first backend query (jax.devices()/jit) — both the
+    modern config flag and the XLA_FLAGS fallback only apply at backend
+    initialization.
+    """
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        flags = [t for t in os.environ.get("XLA_FLAGS", "").split()
+                 if not t.startswith("--xla_force_host_platform_device_count")]
+        flags.append(f"--xla_force_host_platform_device_count={n}")
+        os.environ["XLA_FLAGS"] = " ".join(flags)

@@ -1,10 +1,11 @@
-"""Transformer LM with a MoE FFN — the composed-parallelism flagship.
+"""Transformer LM with MoE FFNs — the composed-parallelism flagship.
 
 The reference is pre-transformer (SURVEY.md §2.5); rounds 3-4 added the
 parallel axes (dp/tp/sp/pp/ep) individually, and the round-4 verdict's gap
-was that no model ever COMPOSED them. This model closes it: one causal
-decoder block (pre-LN multi-head attention + pre-LN top-2 MoE FFN, both with
-residuals, between an embedding and a vocab decoder) that trains on:
+was that no model ever COMPOSED them. This model closes it: ``n_layers``
+causal decoder blocks (pre-LN multi-head attention + pre-LN top-2 MoE FFN,
+both with residuals, stacked via ``lax.scan`` over per-layer params between
+an embedding and a vocab decoder) that train on:
 
 - a single device (dense reference — the parity oracle),
 - dp×ep: batch sharded over "data", experts over "expert"
@@ -12,9 +13,18 @@ residuals, between an embedding and a vocab decoder) that trains on:
 - dp×sp×ep: additionally the sequence axis over "sp" with ring attention
   rotating K/V blocks inside each data-parallel row — three parallelism
   strategies in ONE jitted step,
-- dp×pp: the block split into an attention stage and a MoE-FFN stage on a
-  "pipe" axis, microbatches sharded over "data"
+- dp×pp: the layer stack split at LAYER BOUNDARIES into pipeline stages on
+  a "pipe" axis, microbatches sharded over "data"
   (``make_pp_stages``/parallel.pipeline).
+
+Attention core: every path goes through ops/flash_attention's selection
+seam — an explicit ``attn_impl=`` argument on each builder, else the
+``set_attention_impl`` / ``DL4J_TPU_ATTN_IMPL`` overrides, else auto by
+sequence length (blockwise flash for T at or above the dispatch threshold,
+dense below it — the same shape gating the conv emitter uses). On the
+dp×sp×ep mesh the ring's per-rotated-block core runs the same seam, so the
+composed flagship gets blockwise math end to end (ring_attention
+``attn_impl`` pass-through).
 
 All composed paths are pinned against the dense reference to 1e-5 (loss AND
 updated params) in tests/test_composed.py and gated by the driver's
@@ -38,16 +48,14 @@ from deeplearning4j_tpu.nn.layers.attention import (
     _merge_heads,
     _split_heads,
 )
+from deeplearning4j_tpu.ops.flash_attention import attention_core
 from deeplearning4j_tpu.parallel.moe import (
     EXPERT_AXIS,
     _routing,
     load_balance_loss,
     moe_apply,
 )
-from deeplearning4j_tpu.parallel.ring_attention import (
-    reference_attention,
-    ring_attention,
-)
+from deeplearning4j_tpu.parallel.ring_attention import ring_attention
 
 Array = jax.Array
 
@@ -55,31 +63,55 @@ DATA_AXIS = "data"
 SEQ_AXIS = "sp"
 
 
-def init_lm_params(key: Array, vocab: int, d_model: int, n_heads: int,
-                   n_experts: int, d_ff: int) -> dict:
-    if d_model % n_heads:
-        raise ValueError(f"d_model {d_model} % n_heads {n_heads} != 0")
-    ks = jax.random.split(key, 9)
+def _init_block(key: Array, d_model: int, n_heads: int, n_experts: int,
+                d_ff: int) -> dict:
+    ks = jax.random.split(key, 6)
     n = jax.random.normal
     s_d = 1.0 / (d_model ** 0.5)
     return {
-        "embed": n(ks[0], (vocab, d_model)) * 0.1,
         "ln_g": jnp.ones((d_model,)), "ln_b": jnp.zeros((d_model,)),
-        "wq": n(ks[1], (d_model, d_model)) * s_d,
-        "wk": n(ks[2], (d_model, d_model)) * s_d,
-        "wv": n(ks[3], (d_model, d_model)) * s_d,
-        "wo": n(ks[4], (d_model, d_model)) * s_d,
+        "wq": n(ks[0], (d_model, d_model)) * s_d,
+        "wk": n(ks[1], (d_model, d_model)) * s_d,
+        "wv": n(ks[2], (d_model, d_model)) * s_d,
+        "wo": n(ks[3], (d_model, d_model)) * s_d,
         "ln2_g": jnp.ones((d_model,)), "ln2_b": jnp.zeros((d_model,)),
-        "router": n(ks[5], (d_model, n_experts)) * s_d,
+        "router": n(ks[4], (d_model, n_experts)) * s_d,
         "experts": {
-            "w1": n(ks[6], (n_experts, d_model, d_ff)) * s_d,
+            "w1": n(ks[5], (n_experts, d_model, d_ff)) * s_d,
             "b1": jnp.zeros((n_experts, d_ff)),
-            "w2": n(ks[7], (n_experts, d_ff, d_model)) / (d_ff ** 0.5),
+            "w2": n(jax.random.fold_in(ks[5], 1),
+                    (n_experts, d_ff, d_model)) / (d_ff ** 0.5),
             "b2": jnp.zeros((n_experts, d_model)),
         },
-        "dec_w": n(ks[8], (d_model, vocab)) * s_d,
+    }
+
+
+def init_lm_params(key: Array, vocab: int, d_model: int, n_heads: int,
+                   n_experts: int, d_ff: int, n_layers: int = 1) -> dict:
+    """Embedding + ``n_layers`` stacked decoder blocks + vocab decoder.
+
+    ``params["blocks"]`` leaves carry a leading (n_layers, ...) axis — the
+    scan/pipeline-stage layout (lm_forward scans it; make_pp_stages slices
+    it at layer boundaries)."""
+    if d_model % n_heads:
+        raise ValueError(f"d_model {d_model} % n_heads {n_heads} != 0")
+    if n_layers < 1:
+        raise ValueError(f"n_layers must be >= 1, got {n_layers}")
+    ks = jax.random.split(key, 3 + n_layers)
+    n = jax.random.normal
+    s_d = 1.0 / (d_model ** 0.5)
+    blocks = [_init_block(ks[3 + i], d_model, n_heads, n_experts, d_ff)
+              for i in range(n_layers)]
+    return {
+        "embed": n(ks[0], (vocab, d_model)) * 0.1,
+        "blocks": jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks),
+        "dec_w": n(ks[1], (d_model, vocab)) * s_d,
         "dec_b": jnp.zeros((vocab,)),
     }
+
+
+def lm_n_layers(params: dict) -> int:
+    return jax.tree_util.tree_leaves(params["blocks"])[0].shape[0]
 
 
 def expert_fn(p: dict, t: Array) -> Array:
@@ -109,59 +141,88 @@ def _attn_block(params: dict, h: Array, n_heads: int, attn_core) -> Array:
     return h + _merge_heads(attn_core(q, k, v)) @ params["wo"]
 
 
+def _decoder_block(layer_params: dict, h: Array, n_heads: int, attn_core,
+                   moe_fn) -> tuple:
+    """One decoder block on (B, T, d) → (h, moe_in) with moe_in the
+    (B·T, d) pre-MoE activations (the load-balance aux input)."""
+    h = _attn_block(layer_params, h, n_heads, attn_core)
+    h2 = _layernorm(h, layer_params["ln2_g"], layer_params["ln2_b"])
+    flat = h2.reshape(-1, h2.shape[-1])
+    moe_out = moe_fn(layer_params["router"], layer_params["experts"], flat)
+    return h + moe_out.reshape(h.shape), flat
+
+
 def lm_forward(params: dict, tokens: Array, n_heads: int, attn_core,
                moe_fn) -> tuple:
-    """tokens: (B, T) int32 → (logits (B, T, V), moe_in (B·T, d)).
+    """tokens: (B, T) int32 → (logits (B, T, V), moe_in (L, B·T, d)).
 
     ``attn_core(q, k, v) -> out`` and ``moe_fn(router_w, experts, flat)``
     supply the parallel strategy; every projection/norm is strategy-agnostic
-    and sharded by GSPMD from the argument shardings."""
+    and sharded by GSPMD from the argument shardings. The layer stack runs
+    as ONE ``lax.scan`` over the stacked per-layer params — compile time
+    stays O(1) in depth and the per-layer collectives (ring ppermute, MoE
+    psum) trace once."""
     h = params["embed"][tokens]  # (B, T, d)
-    h = _attn_block(params, h, n_heads, attn_core)
-    h2 = _layernorm(h, params["ln2_g"], params["ln2_b"])
-    flat = h2.reshape(-1, h2.shape[-1])
-    moe_out = moe_fn(params["router"], params["experts"], flat)
-    h = h + moe_out.reshape(h.shape)
-    return h @ params["dec_w"] + params["dec_b"], flat
+
+    def step(h, layer_params):
+        h, flat = _decoder_block(layer_params, h, n_heads, attn_core, moe_fn)
+        return h, flat
+
+    h, moe_ins = jax.lax.scan(step, h, params["blocks"])
+    return h @ params["dec_w"] + params["dec_b"], moe_ins
 
 
 def lm_loss(params: dict, tokens: Array, targets: Array, n_heads: int,
             attn_core, moe_fn, aux_weight: float = 1e-2) -> Array:
-    """Next-token softmax cross-entropy + the Switch load-balance aux."""
-    logits, moe_in = lm_forward(params, tokens, n_heads, attn_core, moe_fn)
+    """Next-token softmax cross-entropy + the Switch load-balance aux
+    (averaged over layers, so the weight is depth-independent)."""
+    logits, moe_ins = lm_forward(params, tokens, n_heads, attn_core, moe_fn)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     task = jnp.mean(nll)
-    return task + aux_weight * load_balance_loss(params["router"], moe_in)
+    aux = jnp.mean(jax.vmap(load_balance_loss)(params["blocks"]["router"],
+                                               moe_ins))
+    return task + aux_weight * aux
 
 
 # --------------------------------------------------------------- builders ----
 
-def dense_loss_fn(n_heads: int, top_k: int = 2, aux_weight: float = 1e-2):
-    """Single-device reference loss (dense attention, dense MoE)."""
+def dense_loss_fn(n_heads: int, top_k: int = 2, aux_weight: float = 1e-2,
+                  attn_impl: Optional[str] = None):
+    """Single-device reference loss (dense MoE; attention through the core
+    seam). ``attn_impl=None`` auto-gates by shape — blockwise flash for long
+    T, dense for short — so the flagship bench runs the fast core without
+    edits; parity oracles pass ``attn_impl="dense"`` to pin the
+    materializing reference."""
     return partial(
         lm_loss, n_heads=n_heads,
-        attn_core=lambda q, k, v: reference_attention(q, k, v, causal=True),
+        attn_core=lambda q, k, v: attention_core(q, k, v, causal=True,
+                                                 impl=attn_impl),
         moe_fn=lambda rw, ex, x: dense_moe(rw, ex, x, top_k),
         aux_weight=aux_weight,
     )
 
 
 def composed_loss_fn(mesh: Mesh, n_heads: int, capacity: int,
-                     top_k: int = 2, aux_weight: float = 1e-2):
+                     top_k: int = 2, aux_weight: float = 1e-2,
+                     attn_impl: Optional[str] = None):
     """Loss with the parallel strategies the mesh's axes call for:
     "data" → batch sharding (GSPMD), "sp" → ring attention over the
     sequence, "expert" → expert-parallel MoE dispatch. Any subset works:
     a ("data","expert") mesh composes dp×ep; ("data","sp","expert")
-    composes all three."""
+    composes all three. ``attn_impl`` forces the attention core on BOTH
+    paths (the ring's per-rotated-block core and the unsharded core);
+    default None resolves via the flash_attention override/env/auto chain.
+    """
     names = mesh.axis_names
     if SEQ_AXIS in names:
-        attn_core = lambda q, k, v: ring_attention(  # noqa: E731
+        attn_core_fn = lambda q, k, v: ring_attention(  # noqa: E731
             q, k, v, mesh, SEQ_AXIS, causal=True,
-            batch_axis=DATA_AXIS if DATA_AXIS in names else None)
+            batch_axis=DATA_AXIS if DATA_AXIS in names else None,
+            attn_impl=attn_impl)
     else:
-        attn_core = lambda q, k, v: reference_attention(  # noqa: E731
-            q, k, v, causal=True)
+        attn_core_fn = lambda q, k, v: attention_core(  # noqa: E731
+            q, k, v, causal=True, impl=attn_impl)
     if EXPERT_AXIS in names:
         token_axes = tuple(a for a in (DATA_AXIS, SEQ_AXIS) if a in names)
         moe_fn = lambda rw, ex, x: moe_apply(  # noqa: E731
@@ -169,21 +230,25 @@ def composed_loss_fn(mesh: Mesh, n_heads: int, capacity: int,
             token_axes=token_axes)
     else:
         moe_fn = lambda rw, ex, x: dense_moe(rw, ex, x, top_k)  # noqa: E731
-    return partial(lm_loss, n_heads=n_heads, attn_core=attn_core,
+    return partial(lm_loss, n_heads=n_heads, attn_core=attn_core_fn,
                    moe_fn=moe_fn, aux_weight=aux_weight)
 
 
 def shard_lm_params(params: dict, mesh: Mesh) -> dict:
     """Experts onto the expert axis (when present), everything else
-    replicated."""
+    replicated. Block leaves carry a leading layer axis, so the expert dim
+    is axis 1 there."""
     names = mesh.axis_names
     rep = NamedSharding(mesh, P())
     out = {k: jax.device_put(v, rep) for k, v in params.items()
-           if k != "experts"}
-    espec = P(EXPERT_AXIS) if EXPERT_AXIS in names else P()
-    out["experts"] = jax.tree_util.tree_map(
+           if k != "blocks"}
+    blocks = {k: jax.device_put(v, rep) for k, v in params["blocks"].items()
+              if k != "experts"}
+    espec = P(None, EXPERT_AXIS) if EXPERT_AXIS in names else P()
+    blocks["experts"] = jax.tree_util.tree_map(
         lambda a: jax.device_put(a, NamedSharding(mesh, espec)),
-        params["experts"])
+        params["blocks"]["experts"])
+    out["blocks"] = blocks
     return out
 
 
@@ -198,13 +263,15 @@ def shard_lm_batch(tokens: Array, targets: Array, mesh: Mesh) -> tuple:
 
 def make_composed_train_step(mesh: Mesh, n_heads: int, capacity: int,
                              lr: float = 0.1, top_k: int = 2,
-                             aux_weight: float = 1e-2):
+                             aux_weight: float = 1e-2,
+                             attn_impl: Optional[str] = None):
     """SGD step over the composed mesh: step(params, tokens, targets) ->
     (new_params, loss). Shard inputs with shard_lm_params/shard_lm_batch
     first; GSPMD + the shard_map transposes insert every collective
     (grad AllReduce over data/sp, expert-grad reduce over token axes,
     K/V ppermute ring, MoE psum)."""
-    loss_fn = composed_loss_fn(mesh, n_heads, capacity, top_k, aux_weight)
+    loss_fn = composed_loss_fn(mesh, n_heads, capacity, top_k, aux_weight,
+                               attn_impl=attn_impl)
 
     @jax.jit
     def step(params, tokens, targets):
@@ -216,9 +283,12 @@ def make_composed_train_step(mesh: Mesh, n_heads: int, capacity: int,
 
 
 def make_single_device_train_step(n_heads: int, lr: float = 0.1,
-                                  top_k: int = 2, aux_weight: float = 1e-2):
-    """The dense twin of make_composed_train_step (parity oracle)."""
-    loss_fn = dense_loss_fn(n_heads, top_k, aux_weight)
+                                  top_k: int = 2, aux_weight: float = 1e-2,
+                                  attn_impl: Optional[str] = None):
+    """The dense twin of make_composed_train_step (parity oracle when
+    called with ``attn_impl="dense"``; the flagship single-chip bench path
+    with the default auto core)."""
+    loss_fn = dense_loss_fn(n_heads, top_k, aux_weight, attn_impl=attn_impl)
 
     @jax.jit
     def step(params, tokens, targets):
@@ -231,48 +301,49 @@ def make_single_device_train_step(n_heads: int, lr: float = 0.1,
 
 # ----------------------------------------------------------------- dp×pp ----
 
-PP_STAGE_KEYS = ("ln_g", "ln_b", "wq", "wk", "wv", "wo", "ln2_g", "ln2_b",
-                 "router")
+def make_pp_stages(params: dict, n_heads: int, n_stages: int = 2,
+                   top_k: int = 2, attn_impl: Optional[str] = None):
+    """Split the decoder stack at LAYER BOUNDARIES into ``n_stages``
+    pipeline stages — stage i owns layers [i·L/S, (i+1)·L/S) and applies
+    them with a local ``lax.scan`` (dense experts: the pipe axis shards
+    STAGES, not experts). Requires n_layers % n_stages == 0.
 
-
-def make_pp_stages(params: dict, n_heads: int, top_k: int = 2):
-    """Split the block into pipeline stages: stage 0 = attention block,
-    stage 1 = MoE FFN (dense experts — the pipe axis shards STAGES, not
-    experts). Returns (per_stage_params, stage_fn) for
+    Returns (per_stage_params, stage_fn) for
     parallel.pipeline.stack_stage_params / pipeline_apply; embed/decoder
     stay outside the pipe (applied before/after), activations are
-    (mb, T, d) — uniform, as pipelining requires.
+    (mb, T, d) — uniform, as pipelining requires. Every stage carries the
+    same (L/S, ...) param structure, so the stacked pytree is uniform with
+    no zero-padded union slots; gradients per layer are exact (the round-5
+    union-zero/lax.switch staging is gone with the depth axis).
 
-    Both stages carry the UNION param structure (zeros in the slots the
-    other stage owns) so the stacked pytree is uniform; ``lax.switch`` on
-    the stage index runs the right math, and the unused slots receive
-    exactly zero gradient, so training matches the unstaged model."""
-    union_zero = {k: jnp.zeros_like(params[k]) for k in PP_STAGE_KEYS}
-    union_zero["experts"] = jax.tree_util.tree_map(jnp.zeros_like,
-                                                   params["experts"])
-    stage0 = dict(union_zero)
-    for k in ("ln_g", "ln_b", "wq", "wk", "wv", "wo"):
-        stage0[k] = params[k]
-    stage1 = dict(union_zero)
-    for k in ("ln2_g", "ln2_b", "router"):
-        stage1[k] = params[k]
-    stage1["experts"] = params["experts"]
+    ``attn_impl`` forces the attention core of every staged layer; default
+    None resolves via the flash_attention override/env/auto chain on the
+    microbatch sequence length."""
+    blocks = params["blocks"]
+    n_layers = lm_n_layers(params)
+    if n_layers % n_stages:
+        raise ValueError(
+            f"n_layers={n_layers} does not split over {n_stages} pipeline "
+            "stages — layer-boundary staging needs n_layers % n_stages == 0")
+    per = n_layers // n_stages
+    per_stage = [
+        jax.tree_util.tree_map(lambda a: a[i * per:(i + 1) * per], blocks)
+        for i in range(n_stages)
+    ]
 
-    def attn_stage(p, x):
-        core = lambda q, k, v: reference_attention(q, k, v, causal=True)  # noqa: E731
-        return _attn_block(p, x, n_heads, core)
-
-    def moe_stage(p, x):
-        h2 = _layernorm(x, p["ln2_g"], p["ln2_b"])
-        flat = h2.reshape(-1, h2.shape[-1])
-        return x + dense_moe(p["router"], p["experts"], flat,
-                             top_k).reshape(x.shape)
+    core = lambda q, k, v: attention_core(q, k, v, causal=True,  # noqa: E731
+                                          impl=attn_impl)
+    moe = lambda rw, ex, x: dense_moe(rw, ex, x, top_k)  # noqa: E731
 
     def stage_fn(p, x):
-        my = jax.lax.axis_index("pipe")
-        return jax.lax.switch(my, [attn_stage, moe_stage], p, x)
+        def step(h, layer_params):
+            h, _ = _decoder_block(layer_params, h, n_heads, core, moe)
+            return h, None
 
-    return [stage0, stage1], stage_fn
+        h, _ = jax.lax.scan(step, x, p)
+        return h
+
+    return per_stage, stage_fn
 
 
 def make_pp_loss(stage_fn, mesh: Mesh, pipe_axis: str,

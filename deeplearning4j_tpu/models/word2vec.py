@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.compat import shard_map
+
 from deeplearning4j_tpu.models.embeddings import (
     InMemoryLookupTable,
     cosine_nearest,
@@ -410,7 +412,7 @@ def make_sharded_sgns_step(mesh, negative: int, neg_group: int = 0):
         syn1neg = syn1neg - lr * g1 / jnp.maximum(c1, 1.0)[:, None]
         return syn0, syn1neg, loss
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         step,
         mesh=mesh,
         in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
@@ -452,7 +454,7 @@ def make_sharded_hs_step(mesh):
         syn1 = syn1 - lr * g1 / jnp.maximum(c1, 1.0)[:, None]
         return syn0, syn1, loss
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         step,
         mesh=mesh,
         in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),

@@ -30,11 +30,28 @@ dtype (bf16 inputs hit the MXU as bf16, accumulation stays f32), matching
 ``parallel/ring_attention.py``'s accumulation math — ring attention is this
 same algorithm with the block loop unrolled over ICI neighbors instead of
 a local scan.
+
+Core selection precedence (highest wins):
+
+  1. a per-call ``impl=`` argument (``attention_core``,
+     models/transformer_lm.py's ``attn_impl=`` seam),
+  2. ``set_attention_impl(...)`` — the process-wide programmatic override,
+  3. the ``DL4J_TPU_ATTN_IMPL`` environment variable
+     (``dense|blockwise|flash``) — lets the bench A/B twins and the driver's
+     ``dryrun_multichip`` force a core without code edits,
+  4. auto: blockwise for block-aligned T >= the dispatch threshold
+     (measured faster on v5e, see above), dense below it.
+
+``resolve_attention_impl`` implements the chain; it is consulted by the
+dense dispatcher here AND by the sharded seams (ring attention's per-block
+core and ulysses' post-AllToAll core in parallel/ring_attention.py), so one
+switch steers every attention call in the tree.
 """
 
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -49,6 +66,12 @@ _NEG_INF = -1e30
 # reference for short T); "flash" | "blockwise" | "dense" force one path
 _impl_override: Optional[str] = None
 
+# environment override, consulted when set_attention_impl was not called
+# (precedence chain in the module docstring)
+ATTN_IMPL_ENV = "DL4J_TPU_ATTN_IMPL"
+
+_IMPLS = ("flash", "blockwise", "dense")
+
 # dense path below this length: at tiny T the (T,T) buffer is cheap and the
 # block loop's fixed overhead dominates
 _BLOCKWISE_MIN_T = 1024
@@ -58,7 +81,7 @@ _DEFAULT_BLOCK = 512
 def set_attention_impl(impl: Optional[str]) -> None:
     """Force the attention core: "flash" (pallas TPU kernel), "blockwise"
     (portable scan), "dense" (materializing reference), or None for auto."""
-    if impl not in (None, "flash", "blockwise", "dense"):
+    if impl not in (None,) + _IMPLS:
         raise ValueError(f"unknown attention impl {impl!r}; "
                          "options: flash, blockwise, dense, None")
     global _impl_override
@@ -66,7 +89,32 @@ def set_attention_impl(impl: Optional[str]) -> None:
 
 
 def get_attention_impl() -> Optional[str]:
-    return _impl_override
+    """The effective global override: set_attention_impl's value, else the
+    ``DL4J_TPU_ATTN_IMPL`` environment variable, else None (auto)."""
+    if _impl_override is not None:
+        return _impl_override
+    env = os.environ.get(ATTN_IMPL_ENV)
+    if env:
+        if env not in _IMPLS:
+            raise ValueError(
+                f"{ATTN_IMPL_ENV}={env!r}; options: " + ", ".join(_IMPLS))
+        return env
+    return None
+
+
+def resolve_attention_impl(t: Optional[int] = None) -> Optional[str]:
+    """Collapse the precedence chain to the impl that will actually run:
+    programmatic override > env var > (given a sequence length) the auto
+    shape gate. Returns None only when no override is set AND no ``t`` was
+    supplied."""
+    impl = get_attention_impl()
+    if impl is None and t is not None:
+        if t >= _BLOCKWISE_MIN_T and t % min(_DEFAULT_BLOCK, t) == 0:
+            impl = "blockwise"  # measured faster than the pallas kernel on
+            #                     v5e at T=2048 and T=8192 (module docstring)
+        else:
+            impl = "dense"
+    return impl
 
 
 # ------------------------------------------------------------------ dense ----
@@ -262,6 +310,76 @@ def _blockwise_vjp_bwd(causal, bq, bk, res, do):
 blockwise_attention.defvjp(_blockwise_vjp_fwd, _blockwise_vjp_bwd)
 
 
+# ------------------------------------------- sharded-seam block partials ----
+
+def _pick_block(t: int) -> int:
+    """Largest tile <= _DEFAULT_BLOCK dividing t (t itself if none does)."""
+    blk = min(_DEFAULT_BLOCK, t)
+    return blk if t % blk == 0 else t
+
+
+def blockwise_block_partials(q: Array, k: Array, v: Array, q_offset=0,
+                             k_offset=0, causal: bool = False,
+                             block_q: Optional[int] = None,
+                             block_k: Optional[int] = None) -> tuple:
+    """Online-softmax over ONE Q-shard × K/V-shard pair with GLOBAL position
+    offsets — the per-block core ring attention routes through when the
+    resolved impl is "blockwise" (q sits at sequence position ``q_offset``,
+    the rotated K/V block at ``k_offset``; both may be traced values).
+
+    q: (B,H,Tq,D), k/v: (B,H,Tk,D). Returns (o_norm, lse) f32: the pair's
+    softmax-normalized output and logsumexp. Shards merge exactly via
+    logsumexp weights — o = Σ_j o_norm_j · exp(lse_j − LSE) with
+    LSE = logsumexp_j(lse_j) — which is ring_attention's online merge with
+    (m=lse, l=1). The (Tq,Tk) score rectangle is never materialized; plain
+    lax ops (no custom VJP), so callers differentiate straight through the
+    block scan. Rows masked in EVERY block come out as (0, ≈-inf) and drop
+    out of the merge.
+    """
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    bq = block_q or _pick_block(tq)
+    bk = block_k or _pick_block(tk)
+    nq, nk = tq // bq, tk // bk
+    scale = 1.0 / (d ** 0.5)
+    kb = k.reshape(b, h, nk, bk, d).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(b, h, nk, bk, d).transpose(2, 0, 1, 3, 4)
+
+    os_, lses = [], []
+    for i in range(nq):
+        q_blk = jax.lax.dynamic_slice_in_dim(q, i * bq, bq, axis=2)
+
+        def step(j, carry, q_blk=q_blk, qi=i):
+            o, l, m = carry
+            kj = jax.lax.dynamic_index_in_dim(kb, j, 0, keepdims=False)
+            vj = jax.lax.dynamic_index_in_dim(vb, j, 0, keepdims=False)
+            s = jnp.einsum("bhqd,bhkd->bhqk", q_blk, kj,
+                           preferred_element_type=jnp.float32) * scale
+            if causal:
+                # offsets may be traced (ring rotation index): the mask is
+                # computed per block — no static diagonal short-circuit here
+                q_pos = q_offset + qi * bq + jnp.arange(bq)
+                k_pos = k_offset + j * bk + jnp.arange(bk)
+                s = s + jnp.where(q_pos[:, None] >= k_pos[None, :], 0.0,
+                                  _NEG_INF)[None, None].astype(s.dtype)
+            bm = s.max(axis=-1)
+            m_new = jnp.maximum(m, bm)
+            p = jnp.exp(s - m_new[..., None])
+            alpha = jnp.exp(m - m_new)
+            pv = jnp.einsum("bhqk,bhkd->bhqd", p.astype(vj.dtype), vj,
+                            preferred_element_type=jnp.float32)
+            return (o * alpha[..., None] + pv, l * alpha + p.sum(-1), m_new)
+
+        o0 = jnp.zeros((b, h, bq, d), jnp.float32)
+        l0 = jnp.zeros((b, h, bq), jnp.float32)
+        m0 = jnp.full((b, h, bq), _NEG_INF, jnp.float32)
+        o, l, m = jax.lax.fori_loop(0, nk, step, (o0, l0, m0))
+        l = jnp.maximum(l, 1e-30)  # fully-masked rows: zero weight in merge
+        os_.append(o / l[..., None])
+        lses.append(m + jnp.log(l))
+    return jnp.concatenate(os_, axis=2), jnp.concatenate(lses, axis=2)
+
+
 # ----------------------------------------------------- pallas flash (TPU) ----
 
 def _flash_attention_tpu(q: Array, k: Array, v: Array, causal: bool) -> Array:
@@ -285,22 +403,23 @@ def _flash_attention_tpu(q: Array, k: Array, v: Array, causal: bool) -> Array:
 
 # ------------------------------------------------------------- dispatcher ----
 
-def attention_core(q: Array, k: Array, v: Array, causal: bool = False) -> Array:
+def attention_core(q: Array, k: Array, v: Array, causal: bool = False,
+                   impl: Optional[str] = None) -> Array:
     """The ATTENTION layer's dense core: picks the fastest correct
-    implementation for the shape/platform (override with
-    ``set_attention_impl``). All paths compute the identical function;
-    parity is pinned in tests/test_flash_attention.py."""
-    impl = _impl_override
-    if impl is None:
-        t = q.shape[2]
-        if t >= _BLOCKWISE_MIN_T and t % min(_DEFAULT_BLOCK, t) == 0:
-            impl = "blockwise"  # measured faster than the pallas kernel on
-            #                     v5e at T=2048 and T=8192 (module docstring)
-        else:
-            impl = "dense"
+    implementation for the shape/platform. ``impl`` forces a core for THIS
+    call (the per-call seam models/transformer_lm.py exposes as
+    ``attn_impl=``); otherwise the set_attention_impl/env/auto chain
+    decides. All paths compute the identical function; parity is pinned in
+    tests/test_flash_attention.py."""
+    if impl is not None and impl not in _IMPLS:
+        raise ValueError(f"unknown attention impl {impl!r}; "
+                         "options: " + ", ".join(_IMPLS))
+    impl = impl or resolve_attention_impl(q.shape[2])
     if impl == "flash":
         return _flash_attention_tpu(q, k, v, causal)
     if impl == "blockwise":
-        blk = min(_DEFAULT_BLOCK, q.shape[2])
+        # _pick_block: a forced blockwise core on a non-block-aligned T
+        # falls back to one block rather than a reshape error
+        blk = _pick_block(q.shape[2])
         return blockwise_attention(q, k, v, causal, blk, blk)
     return dense_attention(q, k, v, causal)

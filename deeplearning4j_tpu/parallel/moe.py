@@ -39,6 +39,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu.compat import shard_map
+
 Array = jax.Array
 
 EXPERT_AXIS = "expert"
@@ -126,7 +128,7 @@ def moe_apply(router_w: Array, expert_params, x: Array, mesh: Mesh,
                                top_k)
 
     tok_spec = P(tuple(token_axes) if token_axes else None)
-    return jax.shard_map(
+    return shard_map(
         body, mesh=mesh,
         in_specs=(param_spec, P(), tok_spec), out_specs=tok_spec,
         check_vma=False,

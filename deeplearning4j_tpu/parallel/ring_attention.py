@@ -14,6 +14,16 @@ TPU-native long-context primitives the rebuild adds as first-class citizens:
 
 Both run under ``shard_map`` over a named mesh axis and are validated on the
 8-device CPU mesh in tests (the driver dry-runs the same path).
+
+Attention-core seam: the LOCAL math inside both variants goes through
+ops/flash_attention's core selection (per-call ``attn_impl=`` >
+``set_attention_impl`` > ``DL4J_TPU_ATTN_IMPL`` env > auto by local length).
+For the ring that means each rotated K/V block is processed by the blockwise
+online-softmax tiles (``blockwise_block_partials`` — O(block) memory, exact
+logsumexp merge) instead of a materialized (T_local, T_local) score
+rectangle; for ulysses the post-AllToAll full-sequence attention runs
+through ``attention_core``. The composed dp×sp×ep flagship path therefore
+gets blockwise math end to end.
 """
 
 from __future__ import annotations
@@ -24,6 +34,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.compat import shard_map
 
 Array = jax.Array
 
@@ -42,7 +54,38 @@ def _block_attn(q, k, v, bias):
     return m, p.sum(-1), pv
 
 
-def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool):
+def _ring_block_core(q, k_cur, v_cur, q_offset, k_offset, causal: bool,
+                     impl: str):
+    """The attention seam inside the ring: one rotated (Q-shard, K/V-shard)
+    pair → online-softmax partials (bm, bl, bo) for the merge.
+
+    "blockwise" tiles the pair through flash_attention's online softmax
+    (O(block) score memory, the composed-flagship fast path) and reports the
+    normalized form (m=lse, l=1, o=o_norm) — algebraically the same merge;
+    "dense" is the original materializing ``_block_attn``.
+    """
+    if impl == "blockwise":
+        from deeplearning4j_tpu.ops.flash_attention import (
+            blockwise_block_partials,
+        )
+
+        o_norm, lse = blockwise_block_partials(
+            q, k_cur, v_cur, q_offset=q_offset, k_offset=k_offset,
+            causal=causal)
+        return lse, jnp.ones_like(lse), o_norm
+    if causal:
+        t_q, t_k = q.shape[2], k_cur.shape[2]
+        q_pos = q_offset + jnp.arange(t_q)  # (Tq,)
+        k_pos = k_offset + jnp.arange(t_k)  # (Tk,)
+        mask = q_pos[:, None] >= k_pos[None, :]
+        bias = jnp.where(mask, 0.0, _NEG_INF)[None, None]
+    else:
+        bias = None
+    return _block_attn(q, k_cur, v_cur, bias)
+
+
+def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool,
+                            impl: str = "dense"):
     """Per-device body under shard_map. q/k/v: (B, H, T_local, D)."""
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
@@ -54,14 +97,9 @@ def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool):
         src = (my_idx - step) % axis_size
 
         def attend(o, l, m):
-            if causal:
-                q_pos = my_idx * t_local + jnp.arange(t_local)  # (Tq,)
-                k_pos = src * t_local + jnp.arange(t_local)  # (Tk,)
-                mask = q_pos[:, None] >= k_pos[None, :]
-                bias = jnp.where(mask, 0.0, _NEG_INF)[None, None]
-            else:
-                bias = None
-            bm, bl, bo = _block_attn(q, k_cur, v_cur, bias)
+            bm, bl, bo = _ring_block_core(
+                q, k_cur, v_cur, my_idx * t_local, src * t_local, causal,
+                impl)
             # online softmax merge
             new_m = jnp.maximum(m, bm)
             scale_old = jnp.exp(m - new_m)
@@ -91,18 +129,22 @@ def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool):
         )
         return o, l, m, k_nxt, v_nxt
 
-    o0 = jnp.zeros_like(q)
-    l0 = jnp.zeros(q.shape[:3], q.dtype)
-    m0 = jnp.full(q.shape[:3], _NEG_INF, q.dtype)
+    # f32 accumulators regardless of input dtype (the blockwise core's
+    # partials are f32; dense partials promote) — matching flash_attention's
+    # accumulation discipline
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    l0 = jnp.zeros(q.shape[:3], jnp.float32)
+    m0 = jnp.full(q.shape[:3], _NEG_INF, jnp.float32)
     o, l, m, _, _ = jax.lax.fori_loop(0, axis_size, body, (o0, l0, m0, k, v))
     # fully-masked rows (can't happen with causal self-attention, where
     # position t always sees itself) would have l == 0; guard anyway
-    return o / jnp.maximum(l, 1e-30)[..., None]
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
 def ring_attention(q: Array, k: Array, v: Array, mesh: Mesh, axis: str,
                    causal: bool = False,
-                   batch_axis: Optional[str] = None) -> Array:
+                   batch_axis: Optional[str] = None,
+                   attn_impl: Optional[str] = None) -> Array:
     """Multi-head attention with the SEQUENCE axis sharded over ``axis``.
 
     q/k/v: (B, H, T, D) global arrays (T divisible by the axis size).
@@ -111,19 +153,34 @@ def ring_attention(q: Array, k: Array, v: Array, mesh: Mesh, axis: str,
     ``batch_axis`` composes dp×sp on a 2-D mesh: the batch dim is sharded
     over that axis, so each data-parallel row runs its own K/V ring over
     ``axis`` — the composed-mesh path used by models/transformer_lm.py.
+
+    ``attn_impl`` forces the per-rotated-block core ("blockwise" | "dense");
+    default None resolves through flash_attention's override/env/auto chain
+    on the LOCAL block length T/P ("flash" resolves to blockwise here — the
+    fused pallas kernel is not a mergeable per-block core).
     """
+    from deeplearning4j_tpu.ops.flash_attention import resolve_attention_impl
+
+    t_local = q.shape[2] // mesh.shape[axis]
+    impl = attn_impl or resolve_attention_impl(t_local)
+    if impl == "flash":
+        impl = "blockwise"
     spec = P(batch_axis, None, axis, None)
-    fn = partial(_ring_attention_sharded, axis_name=axis, causal=causal)
-    sharded = jax.shard_map(
+    fn = partial(_ring_attention_sharded, axis_name=axis, causal=causal,
+                 impl=impl)
+    sharded = shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )
     return sharded(q, k, v)
 
 
-def _ulysses_sharded(q, k, v, axis_name: str, causal: bool):
+def _ulysses_sharded(q, k, v, axis_name: str, causal: bool,
+                     impl: Optional[str]):
     """all-to-all: (B, H, T/P, D) -> (B, H/P, T, D), full local attention,
     then back. Requires H % P == 0."""
+    from deeplearning4j_tpu.ops.flash_attention import attention_core
+
     # split heads across devices, gather the full sequence
     def seq_to_heads(x):
         return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
@@ -134,15 +191,20 @@ def _ulysses_sharded(q, k, v, axis_name: str, causal: bool):
                                   tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    out = reference_attention(qh, kh, vh, causal=causal)
+    # the post-AllToAll core runs the SAME seam as every other attention
+    # call (per-call impl > global override > env > auto on the full T)
+    out = attention_core(qh, kh, vh, causal=causal, impl=impl)
     return heads_to_seq(out)
 
 
 def ulysses_attention(q: Array, k: Array, v: Array, mesh: Mesh, axis: str,
-                      causal: bool = False) -> Array:
+                      causal: bool = False,
+                      attn_impl: Optional[str] = None) -> Array:
     """DeepSpeed-Ulysses-style sequence parallelism: all-to-all to head
-    sharding, dense local attention, all-to-all back. H must be divisible by
-    the axis size."""
+    sharding, local attention through the flash_attention core seam
+    (``attn_impl`` forces it; default = override/env/auto on the full
+    sequence length), all-to-all back. H must be divisible by the axis
+    size."""
     axis_size = mesh.shape[axis]
     if q.shape[1] % axis_size != 0:
         raise ValueError(
@@ -150,8 +212,9 @@ def ulysses_attention(q: Array, k: Array, v: Array, mesh: Mesh, axis: str,
             f"({axis_size}); use ring_attention instead"
         )
     spec = P(None, None, axis, None)
-    fn = partial(_ulysses_sharded, axis_name=axis, causal=causal)
-    sharded = jax.shard_map(
+    fn = partial(_ulysses_sharded, axis_name=axis, causal=causal,
+                 impl=attn_impl)
+    sharded = shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )

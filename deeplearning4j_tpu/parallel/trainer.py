@@ -33,6 +33,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu.compat import shard_map
+
 from deeplearning4j_tpu.datasets.iterator import DataSetIterator
 from deeplearning4j_tpu.nn import functional as F
 from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
@@ -124,7 +126,7 @@ def make_sync_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
         return _local_grad_step(conf, params, states, iteration, x, y, w, key,
                                 True, ablate_collectives)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         step,
         mesh=mesh,
         in_specs=(P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
@@ -165,7 +167,7 @@ def make_local_fit_step(conf: MultiLayerConfiguration, mesh: Mesh,
                                    (params, states, scores[-1])), DATA_AXIS)
         return params, states, score
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_fit,
         mesh=mesh,
         in_specs=(P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),

@@ -49,9 +49,16 @@ OUT = "SCALING_r05.json"
 
 _CHILD = r"""
 import sys, time, json
+import os as _os
+_os.environ["XLA_FLAGS"] = (_os.environ.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count="
+                            + sys.argv[1])
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", int(sys.argv[1]))
+try:
+    jax.config.update("jax_num_cpu_devices", int(sys.argv[1]))
+except AttributeError:
+    pass  # 0.4.x: the XLA flag above already did it
 import jax.numpy as jnp
 sys.path.insert(0, {repo!r})
 

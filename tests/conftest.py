@@ -10,5 +10,7 @@ override must go through jax.config before first backend use.
 
 import jax
 
+from deeplearning4j_tpu.compat import set_host_device_count
+
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+set_host_device_count(8)

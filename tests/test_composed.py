@@ -1,7 +1,10 @@
-"""Composed parallelism: ONE transformer LM (ATTENTION + top-2 MoE FFN)
-trained on multi-axis meshes — dp×ep, dp×sp×ep, dp×pp — with every
-composed step pinned against the identical dense single-device step
-(round-4 verdict: the axes existed but were never composed)."""
+"""Composed parallelism: ONE transformer LM (ATTENTION + top-2 MoE FFN,
+``n_layers`` scan-stacked decoder blocks) trained on multi-axis meshes —
+dp×ep, dp×sp×ep, dp×pp — with every composed step pinned against the
+identical dense single-device step (round-4 verdict: the axes existed but
+were never composed; round-6: the BLOCKWISE flash core now runs inside
+every composed path via the attn_impl seam, and the flagship is
+multi-block)."""
 
 import jax
 import jax.numpy as jnp
@@ -12,6 +15,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from deeplearning4j_tpu.models.transformer_lm import (
     dense_loss_fn,
     init_lm_params,
+    lm_n_layers,
     make_composed_train_step,
     make_pp_loss,
     make_pp_stages,
@@ -29,8 +33,9 @@ def _data(seed=1):
     return toks[:, :-1], toks[:, 1:]
 
 
-def _params():
-    return init_lm_params(jax.random.PRNGKey(0), V, D, H, E, DFF)
+def _params(n_experts=E, n_layers=1):
+    return init_lm_params(jax.random.PRNGKey(0), V, D, H, n_experts, DFF,
+                          n_layers=n_layers)
 
 
 def _assert_tree_close(a, b, atol, what):
@@ -42,13 +47,16 @@ def _assert_tree_close(a, b, atol, what):
         assert err < atol, f"{what}: {jax.tree_util.keystr(pa)} diff {err}"
 
 
-def _run_parity(mesh, capacity, atol, steps=3):
-    params = _params()
+def _run_parity(mesh, capacity, atol, steps=3, n_experts=E, n_layers=1,
+                attn_impl=None):
+    """Composed step (optionally with a forced attention core) vs the dense
+    single-device oracle (materializing reference core), loss AND params."""
+    params = _params(n_experts=n_experts, n_layers=n_layers)
     toks, tgts = _data()
     sharded = shard_lm_params(params, mesh)
     stoks, stgts = shard_lm_batch(toks, tgts, mesh)
-    step = make_composed_train_step(mesh, H, capacity)
-    ref_step = make_single_device_train_step(H)
+    step = make_composed_train_step(mesh, H, capacity, attn_impl=attn_impl)
+    ref_step = make_single_device_train_step(H, attn_impl="dense")
     ref_params = params
     for i in range(steps):
         sharded, loss = step(sharded, stoks, stgts)
@@ -57,49 +65,95 @@ def _run_parity(mesh, capacity, atol, steps=3):
         assert abs(float(loss) - float(ref_loss)) < atol, (
             i, float(loss), float(ref_loss))
     _assert_tree_close(jax.device_get(sharded), jax.device_get(ref_params),
-                       atol, f"{mesh.axis_names} params after {steps} steps")
+                       atol,
+                       f"{mesh.axis_names} L={n_layers} impl={attn_impl} "
+                       f"params after {steps} steps")
     return float(loss)
+
+
+def _dp_ep_mesh():
+    return Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                ("data", "expert"))
+
+
+def _dp_sp_ep_mesh():
+    return Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                ("data", "sp", "expert"))
 
 
 def test_dp_ep_parity():
     """dp2×ep4: batch over "data", experts over "expert" — scores and
     updated params equal the dense step to 1e-5 over 3 SGD steps."""
-    devs = np.array(jax.devices()[:8]).reshape(2, 4)
-    mesh = Mesh(devs, ("data", "expert"))
     # ample capacity: tokens per token-shard row = (B/2)·T
-    _run_parity(mesh, capacity=(B // 2) * T, atol=1e-5)
+    _run_parity(_dp_ep_mesh(), capacity=(B // 2) * T, atol=1e-5)
+
+
+def test_dp_ep_blockwise_core_parity():
+    """dp2×ep4 with the BLOCKWISE flash core forced through the attn_impl
+    seam — parity vs the dense-core oracle to 1e-5 (the flash custom VJP
+    is exercised inside the composed grad)."""
+    _run_parity(_dp_ep_mesh(), capacity=(B // 2) * T, atol=1e-5,
+                attn_impl="blockwise")
 
 
 def test_dp_sp_ep_parity():
     """dp2×sp2×ep2: THREE strategies in one jitted step — batch sharding,
     ring attention over the sequence, expert-parallel MoE."""
-    devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
-    mesh = Mesh(devs, ("data", "sp", "expert"))
-    params = _params()
-    # E=2 experts on this mesh: rebuild router/experts for 2 experts
-    p2 = init_lm_params(jax.random.PRNGKey(0), V, D, H, 2, DFF)
-    toks, tgts = _data()
-    sharded = shard_lm_params(p2, mesh)
-    stoks, stgts = shard_lm_batch(toks, tgts, mesh)
-    step = make_composed_train_step(mesh, H, capacity=(B // 2) * (T // 2))
-    ref_step = make_single_device_train_step(H)
-    ref_params = p2
-    for i in range(3):
-        sharded, loss = step(sharded, stoks, stgts)
-        jax.block_until_ready(loss)
-        ref_params, ref_loss = ref_step(ref_params, toks, tgts)
-        # ring attention's online softmax reorders the reduction: 1e-4
-        assert abs(float(loss) - float(ref_loss)) < 1e-4
-    _assert_tree_close(jax.device_get(sharded), jax.device_get(ref_params),
-                       1e-4, "dp×sp×ep params")
-    del params
+    _run_parity(_dp_sp_ep_mesh(), capacity=(B // 2) * (T // 2), atol=1e-4,
+                n_experts=2)
+
+
+def test_dp_sp_ep_blockwise_core_parity():
+    """dp2×sp2×ep2 with the blockwise core inside the RING (each rotated
+    K/V block goes through flash_attention's online-softmax tiles) — the
+    tentpole path: dp×sp×ep × blockwise, parity to 1e-5."""
+    _run_parity(_dp_sp_ep_mesh(), capacity=(B // 2) * (T // 2), atol=1e-5,
+                n_experts=2, attn_impl="blockwise")
+
+
+def test_dp_sp_ep_multiblock_blockwise_parity():
+    """The multi-block flagship (n_layers=2, scan-stacked) on the full
+    dp2×sp2×ep2 mesh with the blockwise core — depth × all three axes."""
+    _run_parity(_dp_sp_ep_mesh(), capacity=(B // 2) * (T // 2), atol=1e-5,
+                n_experts=2, n_layers=2, attn_impl="blockwise")
+
+
+def test_dp_sp_ep_global_override_reaches_ring_core(monkeypatch):
+    """The ACCEPTANCE path: set_attention_impl("blockwise") with NO
+    per-call argument steers the ring's per-rotated-block core inside the
+    composed dp2×sp2×ep2 step — get_attention_impl() observed as
+    "blockwise" inside the block core while the parity run stays pinned to
+    the dense oracle at 1e-5 (the oracle pins its core per-call, which
+    outranks the global override)."""
+    from deeplearning4j_tpu.ops import flash_attention as fa
+
+    seen = {}
+    orig = fa.blockwise_block_partials
+
+    def spy(*args, **kwargs):
+        seen["impl_inside_ring_core"] = fa.get_attention_impl()
+        return orig(*args, **kwargs)
+
+    monkeypatch.setattr(fa, "blockwise_block_partials", spy)
+    try:
+        fa.set_attention_impl("blockwise")
+        _run_parity(_dp_sp_ep_mesh(), capacity=(B // 2) * (T // 2),
+                    atol=1e-5, n_experts=2)
+    finally:
+        fa.set_attention_impl(None)
+    assert seen.get("impl_inside_ring_core") == "blockwise"
+
+
+def test_dp_ep_multiblock_parity():
+    """n_layers=3 on dp2×ep4: the lax.scan depth stacking composes with
+    expert-parallel dispatch (3 layers of shard_map MoE inside one scan)."""
+    _run_parity(_dp_ep_mesh(), capacity=(B // 2) * T, atol=1e-5, n_layers=3)
 
 
 def test_dp_ep_capacity_overflow_still_trains():
     """With a tight capacity the composed step drops tokens (not parity
     with dense) but remains finite and learns."""
-    devs = np.array(jax.devices()[:8]).reshape(2, 4)
-    mesh = Mesh(devs, ("data", "expert"))
+    mesh = _dp_ep_mesh()
     params = shard_lm_params(_params(), mesh)
     toks, tgts = _data()
     stoks, stgts = shard_lm_batch(toks, tgts, mesh)
@@ -113,19 +167,22 @@ def test_dp_ep_capacity_overflow_still_trains():
     assert float(loss) < first
 
 
-def test_dp_pp_trains_with_parity():
-    """dp2×pp2: the SAME transformer split into [attention | MoE-FFN]
-    stages on "pipe" with microbatches sharded over "data" — the SGD loss
-    trajectory matches the unstaged dense model step-for-step."""
+def _pp_parity(n_layers, n_stages, attn_impl=None, steps=4):
+    """dp2×pp2: the multi-block transformer split at LAYER BOUNDARIES into
+    ``n_stages`` stages on "pipe" with microbatches sharded over "data" —
+    the SGD loss trajectory matches the unstaged dense model step-for-step.
+    """
     from deeplearning4j_tpu.parallel.pipeline import (
         shard_stage_params,
         stack_stage_params,
     )
 
-    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    devs = np.array(jax.devices()[:2 * n_stages]).reshape(2, n_stages)
     mesh = Mesh(devs, ("data", "pipe"))
-    params = _params()
-    per_stage, stage_fn = make_pp_stages(params, H)
+    params = _params(n_layers=n_layers)
+    assert lm_n_layers(params) == n_layers
+    per_stage, stage_fn = make_pp_stages(params, H, n_stages=n_stages,
+                                         attn_impl=attn_impl)
     stacked = shard_stage_params(stack_stage_params(per_stage), mesh, "pipe")
 
     n_micro, mb = 4, 2
@@ -136,8 +193,9 @@ def test_dp_pp_trains_with_parity():
     pipe_loss = make_pp_loss(stage_fn, mesh, "pipe", batch_axis="data")
 
     # dense twin: identical math, no staging, no aux (the pp path's task
-    # loss only — aux is a router-training regularizer, orthogonal here)
-    seq_loss_fn = dense_loss_fn(H, aux_weight=0.0)
+    # loss only — aux is a router-training regularizer, orthogonal here);
+    # the oracle always runs the materializing dense core
+    seq_loss_fn = dense_loss_fn(H, aux_weight=0.0, attn_impl="dense")
 
     def seq_loss(ps, toks_flat, tgt_flat):
         return seq_loss_fn(ps, toks_flat, tgt_flat)
@@ -149,7 +207,7 @@ def test_dp_pp_trains_with_parity():
     tgt_flat = tgt_mbs.reshape(-1, T)
     jax.block_until_ready(pipe_loss(trained, toks_mbs, tgt_mbs))
     losses_p, losses_s = [], []
-    for _ in range(4):
+    for _ in range(steps):
         lp, gp = jax.value_and_grad(pipe_loss)(trained, toks_mbs, tgt_mbs)
         trained = jax.tree_util.tree_map(lambda p, g: p - lr * g, trained, gp)
         jax.block_until_ready(lp)
@@ -160,3 +218,30 @@ def test_dp_pp_trains_with_parity():
         losses_s.append(float(ls))
     np.testing.assert_allclose(losses_p, losses_s, atol=1e-5, rtol=1e-5)
     assert losses_p[-1] < losses_p[0]
+    # the staged stack's params must also track the unstaged model's blocks:
+    # stage i's slice == layers [i·L/S, (i+1)·L/S) of the dense twin
+    n_per = n_layers // n_stages
+    stacked_new = jax.device_get(trained[0])
+    seq_blocks = jax.device_get(seq_params["blocks"])
+    restacked = jax.tree_util.tree_map(
+        lambda a: a.reshape((n_layers,) + a.shape[2:]), stacked_new)
+    _assert_tree_close(restacked, seq_blocks, 1e-5,
+                       f"pp L={n_layers}/S={n_stages} stage params")
+
+
+def test_dp_pp_trains_with_parity():
+    """dp2×pp2, n_layers=2, one layer per stage."""
+    _pp_parity(n_layers=2, n_stages=2)
+
+
+def test_dp_pp_multilayer_blockwise_per_stage():
+    """dp2×pp2, n_layers=4 → each stage scans TWO layers locally, every
+    staged layer running the blockwise flash core (one compile covers both
+    the depth-per-stage and the pp×blockwise dimensions)."""
+    _pp_parity(n_layers=4, n_stages=2, attn_impl="blockwise", steps=3)
+
+
+def test_pp_stages_rejects_indivisible_split():
+    params = _params(n_layers=3)
+    with pytest.raises(ValueError, match="layer-boundary"):
+        make_pp_stages(params, H, n_stages=2)

@@ -14,9 +14,13 @@ import numpy as np
 import pytest
 
 from deeplearning4j_tpu.ops.flash_attention import (
+    ATTN_IMPL_ENV,
     attention_core,
     blockwise_attention,
+    blockwise_block_partials,
     dense_attention,
+    get_attention_impl,
+    resolve_attention_impl,
     set_attention_impl,
 )
 
@@ -89,6 +93,67 @@ def test_dispatcher_override_and_auto():
 def test_bad_impl_name_rejected():
     with pytest.raises(ValueError, match="flash"):
         set_attention_impl("fast")
+    q, k, v = _qkv(t=64, d=16)
+    with pytest.raises(ValueError, match="blockwise"):
+        attention_core(q, k, v, impl="fast")
+
+
+def test_env_var_override(monkeypatch):
+    """DL4J_TPU_ATTN_IMPL forces the core without code edits; the
+    programmatic set_attention_impl still wins over it, and a per-call
+    impl= wins over both (precedence chain in the module docstring)."""
+    monkeypatch.setenv(ATTN_IMPL_ENV, "blockwise")
+    assert get_attention_impl() == "blockwise"
+    assert resolve_attention_impl(64) == "blockwise"  # env beats auto gate
+    try:
+        set_attention_impl("dense")
+        assert get_attention_impl() == "dense"  # programmatic beats env
+    finally:
+        set_attention_impl(None)
+    # env-forced blockwise computes the same function at a short T
+    q, k, v = _qkv(t=128, d=32)
+    out_env = attention_core(q, k, v, causal=True)
+    monkeypatch.delenv(ATTN_IMPL_ENV)
+    out_dense = attention_core(q, k, v, causal=True, impl="dense")
+    np.testing.assert_allclose(np.asarray(out_env), np.asarray(out_dense),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_env_var_bad_value_rejected(monkeypatch):
+    monkeypatch.setenv(ATTN_IMPL_ENV, "pallas-ultra")
+    with pytest.raises(ValueError, match=ATTN_IMPL_ENV):
+        get_attention_impl()
+
+
+def test_resolve_auto_gate():
+    assert resolve_attention_impl(64) == "dense"  # below the threshold
+    assert resolve_attention_impl(2048) == "blockwise"
+    assert resolve_attention_impl() is None  # no override, no length
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_block_partials_merge_matches_dense(causal):
+    """blockwise_block_partials over K/V shards merges (logsumexp weights)
+    to exactly the full attention — the ring seam's algebra, checked
+    without a mesh. Offsets are the shards' global positions."""
+    t, shards = 256, 4
+    q, k, v = _qkv(t=t, d=32)
+    ts = t // shards
+    o_parts, lse_parts = [], []
+    for j in range(shards):
+        kj = k[:, :, j * ts:(j + 1) * ts]
+        vj = v[:, :, j * ts:(j + 1) * ts]
+        o_j, lse_j = blockwise_block_partials(
+            q, kj, vj, q_offset=0, k_offset=j * ts, causal=causal,
+            block_q=64, block_k=64)
+        o_parts.append(o_j)
+        lse_parts.append(lse_j)
+    lse = jnp.stack(lse_parts)  # (S, B, H, T)
+    w = jax.nn.softmax(lse, axis=0)[..., None]
+    out = jnp.sum(jnp.stack(o_parts) * w, axis=0)
+    ref = dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
 
 
 def _train_temp_bytes(t, impl):

@@ -15,9 +15,15 @@ import pytest
 
 _CHILD = r"""
 import os, sys
+import os as _os
+_os.environ["XLA_FLAGS"] = (_os.environ.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=2")
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+try:
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:
+    pass  # 0.4.x: the XLA flag above already did it
 sys.path.insert(0, os.environ["DL4J_REPO"])
 
 from deeplearning4j_tpu.parallel import multihost
@@ -46,9 +52,15 @@ print(f"MHOK {pid}", flush=True)
 
 _TRAIN_CHILD = r"""
 import os, sys
+import os as _os
+_os.environ["XLA_FLAGS"] = (_os.environ.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=2")
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+try:
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:
+    pass  # 0.4.x: the XLA flag above already did it
 sys.path.insert(0, os.environ["DL4J_REPO"])
 
 from deeplearning4j_tpu.parallel import multihost
@@ -136,9 +148,15 @@ print(f"MHTRAIN {pid} " + " ".join(f"{s:.6f}" for s in dp_scores), flush=True)
 
 _RING_CHILD = r"""
 import os, sys
+import os as _os
+_os.environ["XLA_FLAGS"] = (_os.environ.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=2")
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+try:
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:
+    pass  # 0.4.x: the XLA flag above already did it
 sys.path.insert(0, os.environ["DL4J_REPO"])
 
 from deeplearning4j_tpu.parallel import multihost

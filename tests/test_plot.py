@@ -31,7 +31,10 @@ def _separation(y, labels):
 
 def test_exact_tsne_separates_clusters():
     x, labels = _clusters()
-    tsne = Tsne(max_iter=300, perplexity=10.0, learning_rate=100.0, seed=7)
+    # 500 iters: at 300 the layout can sit mid-swing (cross/same ~1.96,
+    # just under the 2x bar) depending on the accelerator's reduction
+    # order; by 500 it is decisively separated (~4.8x)
+    tsne = Tsne(max_iter=500, perplexity=10.0, learning_rate=100.0, seed=7)
     y = tsne.calculate(x)
     assert y.shape == (50, 2)
     assert np.all(np.isfinite(y))

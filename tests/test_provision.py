@@ -120,9 +120,15 @@ class TestLaunchCommandDrivesRealTraining:
 
     CHILD = r"""
 import os, sys
+import os as _os
+_os.environ["XLA_FLAGS"] = (_os.environ.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=2")
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+try:
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:
+    pass  # 0.4.x: the XLA flag above already did it
 sys.path.insert(0, os.environ["DL4J_REPO"])
 import numpy as np
 import jax.numpy as jnp

@@ -82,10 +82,13 @@ def _toy_corpus():
 
 
 def test_word2vec_sgns_learns_topics():
+    # lr 0.05: at 0.1 the SGNS steps over-shoot on this tiny corpus (the
+    # neighbor set oscillates run to run / version to version); 0.05
+    # converges to a clean 5/5 topic split
     vec = Word2Vec(
         sentence_iterator=CollectionSentenceIterator(_toy_corpus()),
         layer_size=16, window=3, negative=5, iterations=10,
-        lr=0.1, sample=0, batch_size=128, seed=1,
+        lr=0.05, sample=0, batch_size=128, seed=1,
     )
     vec.fit()
     assert vec.has_word("apple")
