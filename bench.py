@@ -360,8 +360,12 @@ def measure_word2vec(n_sentences: int = 2000, sent_len: int = 100,
     return rate
 
 
+TELEMETRY_INTERVAL = 10  # steps per device->host metrics fetch
+
+
 def measure_lm_composed(steps: int | None = None,
-                        batch: int | None = None) -> float:
+                        batch: int | None = None,
+                        telemetry: bool = True) -> float:
     """End-to-end training samples/sec of the COMPOSED-flagship LM: the
     multi-block (n_layers=2) transformer LM with causal MHA + top-2 MoE
     FFN, trained by models/transformer_lm.make_single_device_train_step.
@@ -371,13 +375,23 @@ def measure_lm_composed(steps: int | None = None,
     the forced-CPU baseline, "dense" for the _densecore A/B twin), so the
     A/B needs no code edits. Same timing discipline as ``measure``: warmup,
     measured fetch latency, run length doubled until a timed run dwarfs the
-    tunnel jitter, median of 3."""
+    tunnel jitter, median of 3.
+
+    ``telemetry``: after the headline rate, A/B the metrics-threaded step
+    (telemetry/) against the plain one — interleaved min-of-N runs at the
+    same k, metrics fetched every TELEMETRY_INTERVAL steps — then run a
+    short logged window through TrainTelemetry and report the step-log
+    summary + measured overhead in the stage detail (the <5% budget is
+    asserted by tests/test_bench_smoke.py)."""
+    import tempfile
+
     import jax
     import jax.numpy as jnp
 
     from deeplearning4j_tpu.models.transformer_lm import (
         init_lm_params,
         make_single_device_train_step,
+        selected_attn_impl,
     )
 
     repeats = 3
@@ -423,12 +437,104 @@ def measure_lm_composed(steps: int | None = None,
     times = [t] + [run(k) for _ in range(repeats - 1)]
     t_med = statistics.median(times)
     rate = k * batch / max(t_med - fetch_lat, 0.2 * t_med)
-    print("STAGE_DETAIL " + json.dumps({
+    detail = {
         "tokens_per_sec": round(rate * seq, 1),
         "seq_len": seq, "n_layers": LMC_LAYERS,
         "attn_impl": os.environ.get("DL4J_TPU_ATTN_IMPL", "auto"),
-    }), flush=True)
+    }
+    if telemetry:
+        detail["telemetry"] = _lm_composed_telemetry(
+            heads, params, tk, tg, k, batch, seq,
+            selected_attn_impl(seq), tempfile, repeats)
+    print("STAGE_DETAIL " + json.dumps(detail), flush=True)
     return rate
+
+
+def _lm_composed_telemetry(heads, params, tk, tg, k, batch, seq,
+                           attn_impl, tempfile, repeats) -> dict:
+    """Telemetry-on vs telemetry-off A/B + a logged window (see
+    measure_lm_composed). Returns the stage-detail telemetry block.
+
+    A/B fairness: BOTH loops fetch at the same cadence — the telemetry-off
+    twin pulls the loss scalar every TELEMETRY_INTERVAL steps (any real
+    training loop logs its loss; an end-only-sync baseline would bill the
+    logging sync, which telemetry-off runs pay too, to telemetry), the
+    telemetry-on loop pulls the full metrics window. Overhead = median of
+    per-pair on/off ratios over interleaved runs at the same k — pairing
+    cancels drift; the median rides out a one-off scheduler hiccup that a
+    min-based estimate inherits from whichever side it hits."""
+    import jax
+
+    from deeplearning4j_tpu.models.transformer_lm import (
+        make_single_device_train_step,
+    )
+    from deeplearning4j_tpu.telemetry import (
+        TrainTelemetry,
+        read_step_log,
+        summarize_step_log,
+    )
+
+    mstep = make_single_device_train_step(heads, with_metrics=True)
+    step = make_single_device_train_step(heads)
+    mparams = jax.tree_util.tree_map(lambda a: a, params)
+    oparams = jax.tree_util.tree_map(lambda a: a, params)
+    interval = TELEMETRY_INTERVAL
+
+    def run_off(kk):
+        nonlocal oparams
+        t0 = time.perf_counter()
+        for i in range(kk):
+            oparams, loss = step(oparams, tk, tg)
+            if (i + 1) % interval == 0:
+                float(loss)  # the loss-logging sync every loop pays
+        float(loss)
+        return time.perf_counter() - t0
+
+    def run_on(kk):
+        nonlocal mparams
+        buf = []
+        t0 = time.perf_counter()
+        for _ in range(kk):
+            mparams, loss, m = mstep(mparams, tk, tg)
+            buf.append(m)
+            if len(buf) >= interval:  # the one sync per window
+                jax.device_get(buf)
+                buf.clear()
+        if buf:
+            jax.device_get(buf)
+        float(loss)
+        return time.perf_counter() - t0
+
+    for _ in range(2):
+        run_on(1)  # compile + warmup the metrics step
+        run_off(1)
+    ratios = []
+    for _ in range(max(repeats, 5)):
+        t_off = run_off(k)
+        t_on = run_on(k)
+        ratios.append(t_on / t_off)
+    overhead_pct = (statistics.median(ratios) - 1.0) * 100.0
+
+    # short logged window through the full host pipeline (session -> JSONL
+    # -> summary) so the bench's telemetry claim is end-to-end, not synthetic
+    log_path = os.path.join(tempfile.mkdtemp(prefix="lmc_telemetry_"),
+                            "steps.jsonl")
+    session = TrainTelemetry(
+        step_log_path=log_path, interval=interval,
+        tokens_per_step=batch * seq,
+        static={"stage": "lm_composed", "attn_impl": attn_impl})
+    log_steps = interval + 2  # spans a fetch boundary
+    for i in range(log_steps):
+        mparams, loss, m = mstep(mparams, tk, tg)
+        session.record(i, m)
+    session.close()
+    summary = summarize_step_log(read_step_log(log_path))
+    return {
+        "interval": interval,
+        "overhead_pct": round(overhead_pct, 2),
+        "steps_logged": summary.get("steps", 0),
+        "step_log_summary": summary,
+    }
 
 
 def mfu(model: str, samples_per_sec: float, precision: str) -> float:
@@ -507,15 +613,17 @@ def run_stage(name: str) -> float:
         if name == "lm_composed":
             # forced-CPU baseline: SAME stage, blockwise core, tiny batch
             # (a CPU full-shape step is seconds — per-sample rate is what
-            # the vs_cpu ratio needs)
+            # the vs_cpu ratio needs); telemetry A/B only on the main stage
             os.environ["DL4J_TPU_ATTN_IMPL"] = "blockwise"
-            return measure_lm_composed(batch=None if _fast() else 1)
+            return measure_lm_composed(batch=None if _fast() else 1,
+                                       telemetry=False)
     if name.startswith("lm_composed"):
         # the env seam (not set_attention_impl) on purpose: proves the
         # no-code-edit switch the driver's dryrun can use too
         os.environ["DL4J_TPU_ATTN_IMPL"] = (
             "dense" if name.endswith("_densecore") else "blockwise")
-        return measure_lm_composed()
+        return measure_lm_composed(
+            telemetry=not name.endswith("_densecore"))
     if name == "word2vec":
         if _fast():
             return measure_word2vec(n_sentences=100, sent_len=20, vocab=200)

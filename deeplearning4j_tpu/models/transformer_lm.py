@@ -54,6 +54,7 @@ from deeplearning4j_tpu.parallel.moe import (
     _routing,
     load_balance_loss,
     moe_apply,
+    router_load_fraction,
 )
 from deeplearning4j_tpu.parallel.ring_attention import ring_attention
 
@@ -185,27 +186,70 @@ def lm_loss(params: dict, tokens: Array, targets: Array, n_heads: int,
     return task + aux_weight * aux
 
 
+def lm_loss_and_metrics(params: dict, tokens: Array, targets: Array,
+                        n_heads: int, attn_core, moe_fn,
+                        aux_weight: float = 1e-2, top_k: int = 2) -> tuple:
+    """``lm_loss`` with an in-graph metrics aux: (loss, metrics).
+
+    The loss is computed by the IDENTICAL op sequence as ``lm_loss`` (bit
+    parity with the unthreaded step is pinned at 0 ulp in
+    tests/test_telemetry.py); the metrics dict only adds reads of
+    intermediates the graph already has — task/aux split and the per-expert
+    router-load fraction (mean over layers; sums to 1 per step)."""
+    logits, moe_ins = lm_forward(params, tokens, n_heads, attn_core, moe_fn)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    task = jnp.mean(nll)
+    aux = jnp.mean(jax.vmap(load_balance_loss)(params["blocks"]["router"],
+                                               moe_ins))
+    loss = task + aux_weight * aux
+    load = jnp.mean(
+        jax.vmap(lambda rw, xin: router_load_fraction(rw, xin, top_k))(
+            params["blocks"]["router"], moe_ins), axis=0)  # (E,)
+    metrics = {
+        "task_loss": task,
+        "aux_loss": aux,
+        "router_load": load,
+    }
+    return loss, metrics
+
+
+def selected_attn_impl(seq_len: int, attn_impl: Optional[str] = None) -> str:
+    """The attention core a step with this sequence length will actually
+    run — per-call arg > global/env override > auto shape gate. Host-side
+    static metadata for the telemetry step log / run-info gauge."""
+    from deeplearning4j_tpu.ops.flash_attention import resolve_attention_impl
+
+    return attn_impl or resolve_attention_impl(seq_len)
+
+
 # --------------------------------------------------------------- builders ----
 
 def dense_loss_fn(n_heads: int, top_k: int = 2, aux_weight: float = 1e-2,
-                  attn_impl: Optional[str] = None):
+                  attn_impl: Optional[str] = None,
+                  with_metrics: bool = False):
     """Single-device reference loss (dense MoE; attention through the core
     seam). ``attn_impl=None`` auto-gates by shape — blockwise flash for long
     T, dense for short — so the flagship bench runs the fast core without
     edits; parity oracles pass ``attn_impl="dense"`` to pin the
-    materializing reference."""
-    return partial(
-        lm_loss, n_heads=n_heads,
+    materializing reference. ``with_metrics`` swaps in the
+    (loss, metrics)-returning twin for telemetry-threaded steps."""
+    kwargs = dict(
+        n_heads=n_heads,
         attn_core=lambda q, k, v: attention_core(q, k, v, causal=True,
                                                  impl=attn_impl),
         moe_fn=lambda rw, ex, x: dense_moe(rw, ex, x, top_k),
         aux_weight=aux_weight,
     )
+    if with_metrics:
+        return partial(lm_loss_and_metrics, top_k=top_k, **kwargs)
+    return partial(lm_loss, **kwargs)
 
 
 def composed_loss_fn(mesh: Mesh, n_heads: int, capacity: int,
                      top_k: int = 2, aux_weight: float = 1e-2,
-                     attn_impl: Optional[str] = None):
+                     attn_impl: Optional[str] = None,
+                     with_metrics: bool = False):
     """Loss with the parallel strategies the mesh's axes call for:
     "data" → batch sharding (GSPMD), "sp" → ring attention over the
     sequence, "expert" → expert-parallel MoE dispatch. Any subset works:
@@ -213,6 +257,9 @@ def composed_loss_fn(mesh: Mesh, n_heads: int, capacity: int,
     composes all three. ``attn_impl`` forces the attention core on BOTH
     paths (the ring's per-rotated-block core and the unsharded core);
     default None resolves via the flash_attention override/env/auto chain.
+    ``with_metrics`` returns the (loss, metrics) twin — the router-load
+    fraction is computed on the GLOBAL (GSPMD-sharded) activations, so it
+    reports the same global balance the dense oracle sees.
     """
     names = mesh.axis_names
     if SEQ_AXIS in names:
@@ -230,6 +277,10 @@ def composed_loss_fn(mesh: Mesh, n_heads: int, capacity: int,
             token_axes=token_axes)
     else:
         moe_fn = lambda rw, ex, x: dense_moe(rw, ex, x, top_k)  # noqa: E731
+    if with_metrics:
+        return partial(lm_loss_and_metrics, n_heads=n_heads,
+                       attn_core=attn_core_fn, moe_fn=moe_fn,
+                       aux_weight=aux_weight, top_k=top_k)
     return partial(lm_loss, n_heads=n_heads, attn_core=attn_core_fn,
                    moe_fn=moe_fn, aux_weight=aux_weight)
 
@@ -261,42 +312,67 @@ def shard_lm_batch(tokens: Array, targets: Array, mesh: Mesh) -> tuple:
     return jax.device_put(tokens, sh), jax.device_put(targets, sh)
 
 
+def _make_sgd_step(loss_fn, lr: float, with_metrics: bool):
+    """jitted SGD step; with metrics the loss fn returns (loss, aux) and the
+    step appends the grad/param-norm block — the loss+grad graph itself is
+    the SAME ops either way (bit-parity pinned in tests/test_telemetry.py)."""
+    if not with_metrics:
+        @jax.jit
+        def step(params, tokens, targets):
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
+            return jax.tree_util.tree_map(lambda p, g: p - lr * g,
+                                          params, grads), loss
+
+        return step
+
+    from deeplearning4j_tpu.telemetry.metrics import train_step_metrics
+
+    @jax.jit
+    def step(params, tokens, targets):
+        (loss, metrics), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, tokens, targets)
+        new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g,
+                                            params, grads)
+        metrics = {**metrics,
+                   **train_step_metrics(params, grads, lr, loss=loss)}
+        return new_params, loss, metrics
+
+    return step
+
+
 def make_composed_train_step(mesh: Mesh, n_heads: int, capacity: int,
                              lr: float = 0.1, top_k: int = 2,
                              aux_weight: float = 1e-2,
-                             attn_impl: Optional[str] = None):
+                             attn_impl: Optional[str] = None,
+                             with_metrics: bool = False):
     """SGD step over the composed mesh: step(params, tokens, targets) ->
     (new_params, loss). Shard inputs with shard_lm_params/shard_lm_batch
     first; GSPMD + the shard_map transposes insert every collective
     (grad AllReduce over data/sp, expert-grad reduce over token axes,
-    K/V ppermute ring, MoE psum)."""
+    K/V ppermute ring, MoE psum).
+
+    ``with_metrics=True`` returns (new_params, loss, metrics) where metrics
+    is an in-graph dict (loss, task/aux split, grad_norm, param_norm,
+    update_ratio, (E,) router_load summing to 1) of DEVICE scalars — feed
+    it to telemetry.TrainTelemetry.record, which fetches every N steps so
+    the hot path stays one dispatch."""
     loss_fn = composed_loss_fn(mesh, n_heads, capacity, top_k, aux_weight,
-                               attn_impl=attn_impl)
-
-    @jax.jit
-    def step(params, tokens, targets):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
-        return jax.tree_util.tree_map(lambda p, g: p - lr * g,
-                                      params, grads), loss
-
-    return step
+                               attn_impl=attn_impl,
+                               with_metrics=with_metrics)
+    return _make_sgd_step(loss_fn, lr, with_metrics)
 
 
 def make_single_device_train_step(n_heads: int, lr: float = 0.1,
                                   top_k: int = 2, aux_weight: float = 1e-2,
-                                  attn_impl: Optional[str] = None):
+                                  attn_impl: Optional[str] = None,
+                                  with_metrics: bool = False):
     """The dense twin of make_composed_train_step (parity oracle when
     called with ``attn_impl="dense"``; the flagship single-chip bench path
-    with the default auto core)."""
-    loss_fn = dense_loss_fn(n_heads, top_k, aux_weight, attn_impl=attn_impl)
-
-    @jax.jit
-    def step(params, tokens, targets):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
-        return jax.tree_util.tree_map(lambda p, g: p - lr * g,
-                                      params, grads), loss
-
-    return step
+    with the default auto core). ``with_metrics`` as on the composed
+    builder."""
+    loss_fn = dense_loss_fn(n_heads, top_k, aux_weight, attn_impl=attn_impl,
+                            with_metrics=with_metrics)
+    return _make_sgd_step(loss_fn, lr, with_metrics)
 
 
 # ----------------------------------------------------------------- dp×pp ----
@@ -347,7 +423,8 @@ def make_pp_stages(params: dict, n_heads: int, n_stages: int = 2,
 
 
 def make_pp_loss(stage_fn, mesh: Mesh, pipe_axis: str,
-                 batch_axis: Optional[str] = None):
+                 batch_axis: Optional[str] = None,
+                 with_metrics: bool = False):
     """Staged-LM task loss for the dp×pp path — embed lookup, the pipeline
     schedule over ``pipe_axis``, decoder, mean NLL. The dense twin is
     ``dense_loss_fn(n_heads, aux_weight=0.0)`` on the flattened
@@ -356,7 +433,12 @@ def make_pp_loss(stage_fn, mesh: Mesh, pipe_axis: str,
     dryrun gate so the two can never drift apart.
 
     loss(trained, toks_mbs, targets_mbs) where trained = (stacked_stage_
-    params, embed, dec_w, dec_b) and toks/targets are (n_micro, mb, T)."""
+    params, embed, dec_w, dec_b) and toks/targets are (n_micro, mb, T).
+
+    ``with_metrics`` returns (loss, metrics) with the per-microbatch NLL
+    means — the pipeline-health signal (a diverging microbatch shows up as
+    one hot row) for telemetry-threaded dp×pp steps
+    (parallel.pipeline.make_pipeline_train_step(with_metrics=True))."""
     from deeplearning4j_tpu.parallel.pipeline import pipeline_apply
 
     def loss(trained, toks_mbs, tgt_mbs):
@@ -367,6 +449,11 @@ def make_pp_loss(stage_fn, mesh: Mesh, pipe_axis: str,
         logits = outs @ dec_w + dec_b
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, tgt_mbs[..., None], -1)[..., 0]
+        if with_metrics:
+            return jnp.mean(nll), {
+                "microbatch_loss": jnp.mean(nll, axis=tuple(
+                    range(1, nll.ndim))),  # (M,)
+            }
         return jnp.mean(nll)
 
     return loss

@@ -116,15 +116,22 @@ class MultiLayerNetwork:
     # ---- training ----
     def fit(self, data: DataLike, labels=None, batch_size: Optional[int] = None) -> None:
         """pretrain → finetune → backprop (ref: MultiLayerNetwork.fit :936-956)."""
+        from deeplearning4j_tpu.optimize.listeners import close_listeners
+
         it = _as_iterator(data, labels, batch_size)
-        if self.conf.pretrain:
-            self.pretrain(it)
-            it.reset()
-            self.finetune(it)
-        if self.conf.backward:
-            it.reset()
-            for batch in it:
-                self._do_backward(batch.features, batch.labels)
+        try:
+            if self.conf.pretrain:
+                self.pretrain(it)
+                it.reset()
+                self.finetune(it)
+            if self.conf.backward:
+                it.reset()
+                for batch in it:
+                    self._do_backward(batch.features, batch.labels)
+        finally:
+            # crash-safe: an exception mid-fit must not leave a profiler
+            # listener's trace window armed (close() is idempotent)
+            close_listeners(self.listeners)
 
     def _ensure_train_step(self):
         if self._train_step is None:
@@ -148,9 +155,12 @@ class MultiLayerNetwork:
             )
             self._iteration += 1
             if self.listeners:
-                s = float(score)
-                for listener in self.listeners:
-                    listener(self, self._iteration, s)
+                from deeplearning4j_tpu.optimize.listeners import (
+                    dispatch_listeners,
+                )
+
+                dispatch_listeners(self.listeners, self, self._iteration,
+                                   float(score))
         self._params, self._train_state = params, state
 
     def fit_epochs(self, data: DataLike, num_epochs: int = 1, labels=None,
@@ -158,22 +168,29 @@ class MultiLayerNetwork:
         """Epoch-style supervised training (one fused step per batch) — the
         TPU-idiomatic loop most benchmarks use; numIterations-per-batch
         semantics remain available via fit()."""
+        from deeplearning4j_tpu.optimize.listeners import (
+            close_listeners,
+            dispatch_listeners,
+        )
+
         self._ensure_train_step()
         it = _as_iterator(data, labels, batch_size)
         params, state = self.params_tree, self._train_state
-        for _ in range(num_epochs):
-            it.reset()
-            for batch in it:
-                params, state, score = self._train_step(
-                    params, state, jnp.asarray(self._iteration),
-                    jnp.asarray(batch.features), jnp.asarray(batch.labels),
-                    self._keys.next(),
-                )
-                self._iteration += 1
-                if self.listeners:
-                    s = float(score)
-                    for listener in self.listeners:
-                        listener(self, self._iteration, s)
+        try:
+            for _ in range(num_epochs):
+                it.reset()
+                for batch in it:
+                    params, state, score = self._train_step(
+                        params, state, jnp.asarray(self._iteration),
+                        jnp.asarray(batch.features), jnp.asarray(batch.labels),
+                        self._keys.next(),
+                    )
+                    self._iteration += 1
+                    if self.listeners:
+                        dispatch_listeners(self.listeners, self,
+                                           self._iteration, float(score))
+        finally:
+            close_listeners(self.listeners)
         self._params, self._train_state = params, state
 
     def pretrain(self, data: DataLike, labels=None) -> None:

@@ -184,8 +184,12 @@ def _blockwise_fwd_impl(q, k, v, causal, bq, bk):
     # (T/512), each iteration is big MXU work, and the causal inner-loop
     # bound is static per block so masked blocks cost nothing
     for i in range(nq):
-        q_blk = jax.lax.dynamic_slice_in_dim(q, i * bq, bq, axis=2)
-        o_i, lse_i = _fwd_q_block(i, q_blk, kb, vb, scale, causal, bq, bk, nk)
+        # per-q-block XProf scope: the loop is unrolled at trace time, so
+        # each tile shows up as its own named phase on the device timeline
+        with jax.named_scope(f"blockwise_q_block_{i}"):
+            q_blk = jax.lax.dynamic_slice_in_dim(q, i * bq, bq, axis=2)
+            o_i, lse_i = _fwd_q_block(i, q_blk, kb, vb, scale, causal, bq,
+                                      bk, nk)
         os.append(o_i)
         lses.append(lse_i)
     o = jnp.concatenate(os, axis=2).astype(q.dtype)

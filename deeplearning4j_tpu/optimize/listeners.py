@@ -3,18 +3,50 @@
 Parity with ref: optimize/api/IterationListener.java + optimize/listeners/
 (ScoreIterationListener, ComposableIterationListener). Called from the host
 side of the solver loop with the iteration index and current score.
+
+Dispatch discipline (ISSUE 2 satellite): every training loop routes its
+callbacks through ``dispatch_listeners`` — one listener raising must never
+kill a training run (logged and skipped) — and closes the chain through
+``close_listeners`` from a ``finally`` so a crash inside e.g. a profiler
+trace window cannot leave the profiler armed.
 """
 
 from __future__ import annotations
 
 import logging
+import math
 import time
-from typing import Callable, Iterable, List
+from typing import Callable, Iterable, List, Optional, Sequence
 
 log = logging.getLogger(__name__)
 
 # listener(model, iteration, score)
 IterationListener = Callable[[object, int, float], None]
+
+
+def dispatch_listeners(listeners: Sequence[IterationListener], model,
+                       iteration: int, score: float) -> None:
+    """Call every listener, logging (not raising) per-listener failures —
+    one bad listener must not kill the training run."""
+    for listener in listeners:
+        try:
+            listener(model, iteration, score)
+        except Exception:
+            log.exception("iteration listener %r failed at iteration %d; "
+                          "continuing", listener, iteration)
+
+
+def close_listeners(listeners: Sequence) -> None:
+    """Best-effort ``close()`` on every listener that has one (profiler
+    trace windows, step-log writers). Safe to call repeatedly; exceptions
+    are logged, never raised — this runs from ``finally`` blocks."""
+    for listener in listeners:
+        close = getattr(listener, "close", None)
+        if callable(close):
+            try:
+                close()
+            except Exception:
+                log.exception("listener %r close() failed", listener)
 
 
 class ScoreIterationListener:
@@ -36,6 +68,9 @@ class ComposableIterationListener:
         for listener in self._listeners:
             listener(model, iteration, score)
 
+    def close(self) -> None:
+        close_listeners(self._listeners)
+
 
 class CollectScoresListener:
     """Test/bench helper: records (iteration, score) pairs."""
@@ -51,12 +86,22 @@ class TimingIterationListener:
     """Wall-clock per-iteration timing (ref: the YARN worker's StopWatch
     fields totalRunTimeWatch/batchWatch, impl/multilayer/WorkerNode.java).
     The first callback only arms the clock (so compile/setup time before
-    iteration 0 is not counted); each later callback records the gap."""
+    iteration 0 is not counted); each later callback records the gap.
 
-    def __init__(self, print_iterations: int = 50):
+    Telemetry bridges: pass ``tracker=`` (a scaleout StateTracker) to mirror
+    each gap into its ``job_ms_total`` counter — scaleout workers then
+    report through the same channel as the reference's WorkerActor
+    heartbeat-ms — and/or ``registry=`` (telemetry.MetricsRegistry) to
+    observe the gap into an ``iteration_ms`` histogram.
+    """
+
+    def __init__(self, print_iterations: int = 50, tracker=None,
+                 registry=None):
         self._last: "float | None" = None
         self.print_iterations = max(1, print_iterations)
         self.timings_ms: List[float] = []
+        self.tracker = tracker
+        self.registry = registry
 
     def __call__(self, model, iteration: int, score: float) -> None:
         now = time.perf_counter()
@@ -66,6 +111,10 @@ class TimingIterationListener:
         ms = (now - self._last) * 1000.0
         self._last = now
         self.timings_ms.append(ms)
+        if self.tracker is not None:
+            self.tracker.increment("job_ms_total", ms)
+        if self.registry is not None:
+            self.registry.histogram("iteration_ms").observe(ms)
         if iteration % self.print_iterations == 0:
             log.info("Iteration %d took %.2f ms (score %s)", iteration, ms, score)
 
@@ -74,3 +123,62 @@ class TimingIterationListener:
 
     def mean_ms(self) -> float:
         return self.total_ms() / max(len(self.timings_ms), 1)
+
+    def _percentile_ms(self, q: float) -> float:
+        """Nearest-rank percentile over the recorded gaps (0 when empty)."""
+        if not self.timings_ms:
+            return 0.0
+        s = sorted(self.timings_ms)
+        rank = max(1, math.ceil(q / 100.0 * len(s)))
+        return s[rank - 1]
+
+    def p50_ms(self) -> float:
+        return self._percentile_ms(50.0)
+
+    def p95_ms(self) -> float:
+        return self._percentile_ms(95.0)
+
+
+class MetricsIterationListener:
+    """Bridge the host listener chain into the telemetry layer: each
+    callback lands the score as gauge ``<prefix>_score``, bumps
+    ``<prefix>_iterations_total``, observes the inter-iteration gap into
+    the ``<prefix>_iteration_ms`` histogram, and (optionally) appends a
+    JSONL step event — so MultiLayerNetwork/Solver/ParameterAveraging runs
+    export through the same registry/Prometheus endpoint as the
+    metrics-threaded composed steps."""
+
+    def __init__(self, registry=None, step_log_path: Optional[str] = None,
+                 prefix: str = "train"):
+        from deeplearning4j_tpu.telemetry.registry import (
+            MetricsRegistry,
+            default_registry,
+        )
+
+        self.registry = (registry if registry is not None
+                         else default_registry())
+        assert isinstance(self.registry, MetricsRegistry)
+        self.prefix = prefix
+        self._writer = None
+        if step_log_path:
+            from deeplearning4j_tpu.telemetry.step_log import StepLogWriter
+
+            self._writer = StepLogWriter(step_log_path)
+        self._last: "float | None" = None
+
+    def __call__(self, model, iteration: int, score: float) -> None:
+        now = time.perf_counter()
+        wall_ms = None if self._last is None else (now - self._last) * 1000.0
+        self._last = now
+        reg, p = self.registry, self.prefix
+        reg.counter(f"{p}_iterations_total").inc()
+        reg.gauge(f"{p}_score").set(float(score))
+        if wall_ms is not None:
+            reg.histogram(f"{p}_iteration_ms").observe(wall_ms)
+        if self._writer is not None:
+            self._writer.write(iteration, wall_ms=wall_ms,
+                               score=float(score))
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()

@@ -130,27 +130,36 @@ class Solver:
         """Run the configured algorithm; ``algo`` overrides the conf's choice
         (used e.g. to force iteration GD for CD-k pretraining, whose gradient
         does not come from the score surface)."""
+        from deeplearning4j_tpu.optimize.listeners import close_listeners
+
         algo = algo or self.conf.optimization_algo
         if key is None:
             key = jax.random.PRNGKey(self.conf.seed)
-        if algo in (
-            OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT,
-            OptimizationAlgorithm.GRADIENT_DESCENT,
-        ):
-            return self._iteration_gd(params, key)
-        if algo == OptimizationAlgorithm.CONJUGATE_GRADIENT:
-            return self._conjugate_gradient(params, key)
-        if algo == OptimizationAlgorithm.HESSIAN_FREE:
-            return self._hessian_free(params, key)
-        if algo == OptimizationAlgorithm.LBFGS:
-            return self._lbfgs(params, key)
-        raise ValueError(f"Unhandled optimization algorithm {algo}")
+        try:
+            if algo in (
+                OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT,
+                OptimizationAlgorithm.GRADIENT_DESCENT,
+            ):
+                return self._iteration_gd(params, key)
+            if algo == OptimizationAlgorithm.CONJUGATE_GRADIENT:
+                return self._conjugate_gradient(params, key)
+            if algo == OptimizationAlgorithm.HESSIAN_FREE:
+                return self._hessian_free(params, key)
+            if algo == OptimizationAlgorithm.LBFGS:
+                return self._lbfgs(params, key)
+            raise ValueError(f"Unhandled optimization algorithm {algo}")
+        finally:
+            # a crash inside e.g. a profiler listener's trace window must
+            # not leave the profiler armed (listener close() is a no-op
+            # when no window is open, so mid-chain closes are harmless)
+            close_listeners(self.listeners)
 
     # ---- shared helpers ----
     def _notify(self, iteration: int, score: float):
+        from deeplearning4j_tpu.optimize.listeners import dispatch_listeners
+
         self.score_history.append(score)
-        for listener in self.listeners:
-            listener(self, iteration, score)
+        dispatch_listeners(self.listeners, self, iteration, score)
 
     def _should_stop(self, score: float, old_score: float, grad_norm: float) -> bool:
         return any(t.terminate(score, old_score, grad_norm) for t in self._terminations)

@@ -149,6 +149,19 @@ def load_balance_loss(router_w: Array, x: Array) -> Array:
     return n_experts * jnp.sum(f * p_mean)
 
 
+def router_load_fraction(router_w: Array, x: Array, top_k: int = 1) -> Array:
+    """(E,) fraction of (token, choice) routes landing on each expert —
+    sums to EXACTLY 1 per step (each of the N·k routes counts once). The
+    in-graph telemetry twin of ``expert_load``: differentiation-free
+    (one-hot of the routing argtop), cheap enough to ride every train step,
+    and the balance gauge the step log / Prometheus export surface as
+    ``router_load{expert=...}``."""
+    idx, _ = _routing(x @ router_w, top_k)
+    n_experts = router_w.shape[1]
+    onehot = jax.nn.one_hot(idx, n_experts)  # (N, k, E)
+    return jnp.mean(onehot, axis=(0, 1))
+
+
 def expert_load(router_w: Array, x: Array, top_k: int = 1) -> Array:
     """(E,) count of tokens routed to each expert (any of their k choices)
     — the balance diagnostic used by tests and capacity tuning."""

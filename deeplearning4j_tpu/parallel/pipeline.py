@@ -55,7 +55,11 @@ def _pipeline_body(stage_params, x_mbs, stage_fn, axis_name: str):
         feed = jax.lax.dynamic_index_in_dim(
             x_mbs, jnp.minimum(t, n_micro - 1), axis=0, keepdims=False)
         x_in = jnp.where(my == 0, feed, recv)
-        y = stage_fn(stage_params, x_in)
+        # XProf phase naming: each device's row shows its own stage id, so
+        # "pp_stage_compute" per tick + the ppermute scope below make the
+        # bubble structure readable straight off the timeline
+        with jax.named_scope("pp_stage_compute"):
+            y = stage_fn(stage_params, x_in)
         # the last stage finishes microbatch (t − S + 1) at tick t
         out_idx = t - (n_stages - 1)
         write = (my == n_stages - 1) & (out_idx >= 0)
@@ -65,7 +69,8 @@ def _pipeline_body(stage_params, x_mbs, stage_fn, axis_name: str):
                 outputs, jnp.maximum(out_idx, 0), axis=0, keepdims=False)),
             jnp.maximum(out_idx, 0), axis=0)
         # shift activations one stage forward (ring; stage 0's recv is unused)
-        recv_next = jax.lax.ppermute(y, axis_name, fwd)
+        with jax.named_scope("pp_activation_ppermute"):
+            recv_next = jax.lax.ppermute(y, axis_name, fwd)
         return (recv_next, outputs), None
 
     recv0 = jnp.zeros(x_mbs.shape[1:], x_mbs.dtype)
@@ -247,7 +252,8 @@ def heterogeneous_pipeline_from_conf(conf, params, mesh: Mesh,
 def make_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
                              mesh: Mesh, axis: str = PIPE_AXIS,
                              lr: float = 0.1,
-                             batch_axis: "str | None" = None):
+                             batch_axis: "str | None" = None,
+                             with_metrics: bool = False):
     """SGD train step over the pipelined stack.
 
     loss = mean over microbatches of ``loss_fn(y, labels_mb)`` on the
@@ -256,19 +262,42 @@ def make_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
     step(stacked_params, x_mbs, y_mbs) -> (new_params, loss).
     ``batch_axis`` composes dp×pp (see pipeline_apply); the loss mean then
     spans the sharded microbatch dim, so GSPMD reduces it across the rows.
+
+    ``with_metrics=True`` appends the in-graph telemetry block (loss,
+    grad_norm, param_norm, update_ratio, per-microbatch loss vector) and
+    returns (new_params, loss, metrics) — same loss/grad graph, so params
+    stay bit-identical to the plain step.
     """
 
     def loss_of(params, x_mbs, y_mbs):
         outs = pipeline_apply(params, x_mbs, stage_fn, mesh, axis,
                               batch_axis=batch_axis)
         per = jax.vmap(loss_fn)(outs, y_mbs)
-        return jnp.mean(per)
+        return jnp.mean(per), per
+
+    if not with_metrics:
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(params, x_mbs, y_mbs):
+            (loss, _), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params, x_mbs, y_mbs)
+            new_params = jax.tree_util.tree_map(
+                lambda p, g: p - lr * g, params, grads)
+            return new_params, loss
+
+        return step
+
+    from deeplearning4j_tpu.telemetry.metrics import train_step_metrics
 
     @partial(jax.jit, donate_argnums=(0,))
     def step(params, x_mbs, y_mbs):
-        loss, grads = jax.value_and_grad(loss_of)(params, x_mbs, y_mbs)
+        (loss, per), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(params, x_mbs, y_mbs)
         new_params = jax.tree_util.tree_map(
             lambda p, g: p - lr * g, params, grads)
-        return new_params, loss
+        metrics = {
+            "microbatch_loss": per.reshape(per.shape[0], -1).mean(axis=1),
+            **train_step_metrics(params, grads, lr, loss=loss),
+        }
+        return new_params, loss, metrics
 
     return step

@@ -97,9 +97,13 @@ def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool,
         src = (my_idx - step) % axis_size
 
         def attend(o, l, m):
-            bm, bl, bo = _ring_block_core(
-                q, k_cur, v_cur, my_idx * t_local, src * t_local, causal,
-                impl)
+            # XProf phase name for the per-rotation attention (the rotation
+            # index is the loop-carried `step`; each device's timeline row
+            # shows axis_size of these scopes per call)
+            with jax.named_scope(f"ring_attend[{axis_name}]"):
+                bm, bl, bo = _ring_block_core(
+                    q, k_cur, v_cur, my_idx * t_local, src * t_local, causal,
+                    impl)
             # online softmax merge
             new_m = jnp.maximum(m, bm)
             scale_old = jnp.exp(m - new_m)
@@ -121,8 +125,9 @@ def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool,
         def rotate(kv):
             k_c, v_c = kv
             perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
-            return (jax.lax.ppermute(k_c, axis_name, perm),
-                    jax.lax.ppermute(v_c, axis_name, perm))
+            with jax.named_scope(f"ring_kv_rotate[{axis_name}]"):
+                return (jax.lax.ppermute(k_c, axis_name, perm),
+                        jax.lax.ppermute(v_c, axis_name, perm))
 
         k_nxt, v_nxt = jax.lax.cond(
             step < axis_size - 1, rotate, lambda kv: kv, (k_cur, v_cur)
@@ -190,11 +195,14 @@ def _ulysses_sharded(q, k, v, axis_name: str, causal: bool,
         return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
                                   tiled=True)
 
-    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    with jax.named_scope("ulysses_all2all_seq2heads"):
+        qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     # the post-AllToAll core runs the SAME seam as every other attention
     # call (per-call impl > global override > env > auto on the full T)
-    out = attention_core(qh, kh, vh, causal=causal, impl=impl)
-    return heads_to_seq(out)
+    with jax.named_scope("ulysses_local_attention"):
+        out = attention_core(qh, kh, vh, causal=causal, impl=impl)
+    with jax.named_scope("ulysses_all2all_heads2seq"):
+        return heads_to_seq(out)
 
 
 def ulysses_attention(q: Array, k: Array, v: Array, mesh: Mesh, axis: str,

@@ -46,7 +46,8 @@ Array = jax.Array
 
 
 def _local_grad_step(conf, params, states, iteration, x, y, w, key,
-                     sync_grads: bool, ablate_collectives: bool = False):
+                     sync_grads: bool, ablate_collectives: bool = False,
+                     with_metrics: bool = False):
     """One update step over a weighted batch shard.
 
     ``w`` is a per-row weight (0 for padded rows). The loss is the weighted
@@ -103,16 +104,33 @@ def _local_grad_step(conf, params, states, iteration, x, y, w, key,
         upd_scale = jnp.where(jnp.sum(w) > 0, 1.0, 0.0).astype(jnp.float32)
     new_params = []
     new_states = []
+    updates = []
     for i in range(conf.n_layers):
         upd, st = apply_updater(conf.conf(i), iteration, grads[i], params[i], states[i])
         new_params.append(jax.tree_util.tree_map(
             lambda p, u: p - upd_scale * u, params[i], upd))
         new_states.append(st)
-    return tuple(new_params), tuple(new_states), score
+        updates.append(upd)
+    if not with_metrics:
+        return tuple(new_params), tuple(new_states), score
+    # in-graph telemetry block: appended reductions on intermediates the
+    # step already computed — loss/params stay bit-identical to the
+    # unthreaded step (pinned in tests/test_telemetry.py)
+    from deeplearning4j_tpu.telemetry.metrics import global_norm
+
+    metrics = {
+        "loss": jnp.asarray(score, jnp.float32),
+        "grad_norm": global_norm(grads),
+        "param_norm": global_norm(params),
+        "update_ratio": (global_norm(updates) * upd_scale
+                         / (global_norm(params) + 1e-12)),
+    }
+    return tuple(new_params), tuple(new_states), score, metrics
 
 
 def make_sync_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
-                         ablate_collectives: bool = False):
+                         ablate_collectives: bool = False,
+                         with_metrics: bool = False):
     """Per-step averaging: grads AllReduced every iteration.
 
     step(params, states, iteration, x, y, w, key) — ``w`` is the per-row
@@ -120,17 +138,24 @@ def make_sync_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
 
     ``ablate_collectives`` is scaling-bench instrumentation (measures the
     collective's cost by subtraction); never use it for training.
+
+    ``with_metrics=True`` appends a replicated in-graph metrics dict
+    (loss, grad_norm, param_norm, update_ratio) as a 4th output — the
+    norms are of the POST-AllReduce gradient, so every host sees the same
+    global numbers; feed them to telemetry.TrainTelemetry.
     """
 
     def step(params, states, iteration, x, y, w, key):
         return _local_grad_step(conf, params, states, iteration, x, y, w, key,
-                                True, ablate_collectives)
+                                True, ablate_collectives,
+                                with_metrics=with_metrics)
 
+    out_specs = (P(), P(), P(), P()) if with_metrics else (P(), P(), P())
     sharded = shard_map(
         step,
         mesh=mesh,
         in_specs=(P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
-        out_specs=(P(), P(), P()),
+        out_specs=out_specs,
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0, 1))
@@ -250,34 +275,44 @@ class ParameterAveragingTrainer:
             jax.tree_util.tree_map(jnp.array, net._train_state), rep
         )
 
-        if self.average_each_iteration:
-            if self._sync_step is None:
-                self._sync_step = make_sync_train_step(net.conf, self.mesh)
-            step = self._sync_step
-            for batch in data:
-                x, y, w = self._pad_batch(batch)
-                params, states, score = step(
-                    params, states, jnp.asarray(self._iteration), x, y, w,
-                    net._keys.next(),
-                )
-                self._iteration += 1
-                for listener in net.listeners:
-                    listener(net, self._iteration, float(score))
-        else:
-            if self._fit_step is None:
-                self._fit_step = make_local_fit_step(
-                    net.conf, self.mesh, self.local_iterations
-                )
-            step = self._fit_step
-            for batch in data:
-                x, y, w = self._pad_batch(batch)
-                params, states, score = step(
-                    params, states, jnp.asarray(self._iteration), x, y, w,
-                    net._keys.next(),
-                )
-                self._iteration += self.local_iterations
-                for listener in net.listeners:
-                    listener(net, self._iteration, float(score))
+        from deeplearning4j_tpu.optimize.listeners import (
+            close_listeners,
+            dispatch_listeners,
+        )
+
+        try:
+            if self.average_each_iteration:
+                if self._sync_step is None:
+                    self._sync_step = make_sync_train_step(net.conf, self.mesh)
+                step = self._sync_step
+                for batch in data:
+                    x, y, w = self._pad_batch(batch)
+                    params, states, score = step(
+                        params, states, jnp.asarray(self._iteration), x, y, w,
+                        net._keys.next(),
+                    )
+                    self._iteration += 1
+                    dispatch_listeners(net.listeners, net, self._iteration,
+                                       float(score))
+            else:
+                if self._fit_step is None:
+                    self._fit_step = make_local_fit_step(
+                        net.conf, self.mesh, self.local_iterations
+                    )
+                step = self._fit_step
+                for batch in data:
+                    x, y, w = self._pad_batch(batch)
+                    params, states, score = step(
+                        params, states, jnp.asarray(self._iteration), x, y, w,
+                        net._keys.next(),
+                    )
+                    self._iteration += self.local_iterations
+                    dispatch_listeners(net.listeners, net, self._iteration,
+                                       float(score))
+        finally:
+            # a crash mid-fit must not leave e.g. a ProfilerIterationListener
+            # with an open trace window armed
+            close_listeners(net.listeners)
 
         net._params = jax.tree_util.tree_map(lambda a: a, params)
         net._train_state = states

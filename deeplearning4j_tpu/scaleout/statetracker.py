@@ -60,9 +60,16 @@ class StateTracker:
 
 
 class InMemoryStateTracker(StateTracker):
-    """Thread-safe single-process tracker (the embedded-Hazelcast analogue)."""
+    """Thread-safe single-process tracker (the embedded-Hazelcast analogue).
 
-    def __init__(self):
+    ``metrics_registry`` (a telemetry.MetricsRegistry) mirrors every
+    ``increment`` into a registry counter of the same key, so scaleout
+    workers' job_ms_total / jobs_done / rounds.* counters surface on the
+    same Prometheus endpoint as the training metrics (dotted keys are
+    sanitized at render time)."""
+
+    def __init__(self, metrics_registry=None):
+        self._registry = metrics_registry
         self._lock = threading.RLock()
         self._workers: List[str] = []
         self._jobs: Dict[str, Job] = {}
@@ -150,6 +157,8 @@ class InMemoryStateTracker(StateTracker):
     def increment(self, key: str, by: float = 1.0) -> None:
         with self._lock:
             self._counters[key] += by
+        if self._registry is not None and by >= 0:
+            self._registry.counter(key).inc(by)
 
     def count(self, key: str) -> float:
         with self._lock:

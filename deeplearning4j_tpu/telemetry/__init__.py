@@ -1,0 +1,65 @@
+"""Unified training telemetry (ISSUE 2).
+
+Three layers, one subsystem:
+
+- **in-graph** (telemetry/metrics.py): grad/param global norms, update
+  ratio, router load — dicts of device scalars computed inside the jitted
+  step, parity-safe (0 ulp vs the unthreaded step);
+- **host** (registry.py / step_log.py / session.py): labeled
+  counters/gauges/histograms, the JSONL step-event log, and TrainTelemetry
+  which buffers device metrics and syncs once per N steps;
+- **export** (prometheus.py + ui/server.py routes): Prometheus text format
+  at ``/metrics``, JSON snapshot at ``/api/telemetry``, device memory at
+  ``/api/memory``.
+
+The listener chain bridges in via optimize/listeners.MetricsIterationListener
+and the scaleout counters via the statetracker registry mirror.
+"""
+
+from deeplearning4j_tpu.telemetry.metrics import (
+    global_norm,
+    train_step_metrics,
+    update_metrics,
+)
+from deeplearning4j_tpu.telemetry.prometheus import (
+    CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE,
+    render_prometheus,
+    sanitize_name,
+)
+from deeplearning4j_tpu.telemetry.registry import (
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+)
+from deeplearning4j_tpu.telemetry.session import (
+    DEFAULT_INTERVAL,
+    TrainTelemetry,
+)
+from deeplearning4j_tpu.telemetry.step_log import (
+    StepLogWriter,
+    read_step_log,
+    summarize_step_log,
+)
+
+__all__ = [
+    "Counter",
+    "DEFAULT_BUCKETS",
+    "DEFAULT_INTERVAL",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "PROMETHEUS_CONTENT_TYPE",
+    "StepLogWriter",
+    "TrainTelemetry",
+    "default_registry",
+    "global_norm",
+    "read_step_log",
+    "render_prometheus",
+    "sanitize_name",
+    "summarize_step_log",
+    "train_step_metrics",
+    "update_metrics",
+]

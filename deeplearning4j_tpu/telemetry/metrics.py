@@ -1,0 +1,57 @@
+"""In-graph metric computation: small pytrees of device scalars.
+
+These helpers run INSIDE jitted train steps. A metrics pytree is a flat
+dict of f32 scalars (plus the (E,) router-load vector) computed from
+intermediates the step already has — params, grads, loss — so threading
+them through a step adds a handful of reductions and NO extra dispatch:
+the step still returns in one XLA program, and the host fetches the
+accumulated pytrees only every N steps (telemetry/session.TrainTelemetry).
+
+Bit-parity contract: a metrics-threaded step must produce bit-identical
+loss/params to its unthreaded twin (pinned at 0 ulp on CPU in
+tests/test_telemetry.py) — these functions therefore only READ step
+intermediates, never reorder or perturb the loss/grad computation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_EPS = 1e-12
+
+
+def global_norm(tree) -> Array:
+    """sqrt(sum of squares) over every leaf of a pytree (f32 accumulate)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.float32(0.0)
+    total = sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+    return jnp.sqrt(total)
+
+
+def train_step_metrics(params, grads, lr: float, loss=None) -> dict:
+    """The standard step-health block: grad global-norm, param global-norm,
+    and the update/param ratio (||lr·g|| / ||p|| for SGD — the classic
+    learning-rate sanity signal; ~1e-3 is healthy, >>1e-2 means the step
+    size is fighting the loss surface)."""
+    gn = global_norm(grads)
+    pn = global_norm(params)
+    out = {
+        "grad_norm": gn,
+        "param_norm": pn,
+        "update_ratio": (lr * gn) / (pn + _EPS),
+    }
+    if loss is not None:
+        out["loss"] = jnp.asarray(loss, jnp.float32)
+    return out
+
+
+def update_metrics(params, updates, scale=1.0) -> dict:
+    """Update/param ratio from an explicit update pytree (updater-produced
+    steps where the update is NOT lr·g — momentum/adagrad/rmsprop paths)."""
+    un = global_norm(updates) * scale
+    pn = global_norm(params)
+    return {"param_norm": pn, "update_ratio": un / (pn + _EPS)}

@@ -1,0 +1,92 @@
+"""Prometheus text-format exposition for a MetricsRegistry.
+
+Renders exposition format 0.0.4 (the text format every Prometheus scraper
+speaks): one ``# TYPE`` header per metric family, ``{label="value"}`` pairs
+escaped per the spec, histograms as cumulative ``_bucket{le=...}`` series
+plus ``_sum``/``_count``. Counter families get the conventional ``_total``
+suffix unless the name already carries it.
+
+Mounted on ui/server.py at ``GET /metrics``; the golden test in
+tests/test_telemetry.py pins the exact output.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, List
+
+from deeplearning4j_tpu.telemetry.registry import MetricsRegistry
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize_name(name: str) -> str:
+    """Legalize a metric name (statetracker counters use dotted keys like
+    ``rounds.worker-0`` — dots and dashes become underscores)."""
+    name = _NAME_RE.sub("_", name)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _escape_label_value(v: str) -> str:
+    return v.replace("\\", r"\\").replace("\n", r"\n").replace('"', r'\"')
+
+
+def _labels_str(labels: Dict[str, str], extra: str = "") -> str:
+    parts = [f'{sanitize_name(k)}="{_escape_label_value(str(v))}"'
+             for k, v in sorted(labels.items())]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _fmt(value: float) -> str:
+    if math.isinf(value):
+        return "+Inf" if value > 0 else "-Inf"
+    if float(value) == int(value) and abs(value) < 1e15:
+        return str(int(value))
+    return repr(float(value))
+
+
+def render_prometheus(registry: MetricsRegistry) -> str:
+    """The full exposition page for one registry."""
+    snap = registry.snapshot()
+    lines: List[str] = []
+    seen_type: set = set()
+
+    def type_line(family: str, kind: str) -> None:
+        if family not in seen_type:
+            lines.append(f"# TYPE {family} {kind}")
+            seen_type.add(family)
+
+    for row in snap["counters"]:
+        family = sanitize_name(row["name"])
+        if not family.endswith("_total"):
+            family += "_total"
+        type_line(family, "counter")
+        lines.append(
+            f"{family}{_labels_str(row['labels'])} {_fmt(row['value'])}")
+
+    for row in snap["gauges"]:
+        family = sanitize_name(row["name"])
+        type_line(family, "gauge")
+        lines.append(
+            f"{family}{_labels_str(row['labels'])} {_fmt(row['value'])}")
+
+    for row in snap["histograms"]:
+        family = sanitize_name(row["name"])
+        type_line(family, "histogram")
+        for b in row["buckets"]:
+            le_label = 'le="%s"' % _fmt(b["le"])
+            labels = _labels_str(row["labels"], le_label)
+            lines.append(f"{family}_bucket{labels} {b['count']}")
+        lines.append(
+            f"{family}_sum{_labels_str(row['labels'])} {_fmt(row['sum'])}")
+        lines.append(
+            f"{family}_count{_labels_str(row['labels'])} {row['count']}")
+
+    return "\n".join(lines) + ("\n" if lines else "")

@@ -1,0 +1,122 @@
+"""JSONL step-event log: one line per training step.
+
+Each record is a flat JSON object ``{ts, step, wall_ms, tokens_per_sec,
+<metric>: <value>, ...}`` — the machine-readable twin of the reference's
+ScoreIterationListener log lines, consumable by tools/telemetry_report.py
+and by anything that tails a file. Writes are line-buffered and the writer
+is append-safe across close/reopen (a listener chain may be closed by one
+fit and reused by the next).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import statistics
+import time
+from typing import Dict, List, Optional
+
+
+def _jsonable(v):
+    if hasattr(v, "tolist"):  # numpy / jax scalars and arrays
+        v = v.tolist()
+    if isinstance(v, float) and not math.isfinite(v):
+        return repr(v)  # JSONL stays parseable even on a NaN/inf blow-up
+    return v
+
+
+class StepLogWriter:
+    """Append step events to ``path`` as JSONL.
+
+    ``static`` fields (run metadata: mesh shape, attention impl, model dims)
+    are merged into every record so each line is self-describing.
+    """
+
+    def __init__(self, path: str, static: Optional[Dict] = None):
+        self.path = path
+        self.static = {k: _jsonable(v) for k, v in (static or {}).items()}
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        self._fh = open(path, "a", buffering=1)
+
+    def write(self, step: int, wall_ms: Optional[float] = None,
+              tokens_per_sec: Optional[float] = None, **metrics) -> Dict:
+        rec = {"ts": time.time(), "step": int(step)}
+        if wall_ms is not None:
+            rec["wall_ms"] = round(float(wall_ms), 3)
+        if tokens_per_sec is not None:
+            rec["tokens_per_sec"] = round(float(tokens_per_sec), 1)
+        rec.update(self.static)
+        for k, v in metrics.items():
+            rec[k] = _jsonable(v)
+        if self._fh is None:  # reopened chain (close() is not terminal)
+            self._fh = open(self.path, "a", buffering=1)
+        self._fh.write(json.dumps(rec) + "\n")
+        return rec
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self) -> "StepLogWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_step_log(path: str) -> List[Dict]:
+    """Parse a JSONL step log back into records (skips blank lines)."""
+    out = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def summarize_step_log(records: List[Dict]) -> Dict:
+    """Aggregate a step log into the throughput/grad-norm summary the
+    bench detail and tools/telemetry_report.py print.
+
+    Returns {steps, wall_ms: {mean, p50, p95}, tokens_per_sec_mean,
+    loss: {first, last}, grad_norm: {first, last}, router_load_mean}.
+    Absent fields are simply omitted.
+    """
+    if not records:
+        return {"steps": 0}
+    out: Dict = {"steps": len(records)}
+
+    def series(key):
+        return [r[key] for r in records
+                if isinstance(r.get(key), (int, float))]
+
+    walls = series("wall_ms")
+    if walls:
+        s = sorted(walls)
+
+        def pct(q):
+            return s[min(len(s) - 1, max(0, math.ceil(q / 100 * len(s)) - 1))]
+
+        out["wall_ms"] = {"mean": round(statistics.fmean(walls), 3),
+                          "p50": round(pct(50), 3),
+                          "p95": round(pct(95), 3)}
+    tps = series("tokens_per_sec")
+    if tps:
+        out["tokens_per_sec_mean"] = round(statistics.fmean(tps), 1)
+    for key in ("loss", "score", "grad_norm", "param_norm", "update_ratio"):
+        vals = series(key)
+        if vals:
+            out[key] = {"first": round(vals[0], 6), "last": round(vals[-1], 6)}
+    loads = [r["router_load"] for r in records
+             if isinstance(r.get("router_load"), list)]
+    if loads:
+        n = len(loads)
+        out["router_load_mean"] = [
+            round(sum(l[e] for l in loads) / n, 4)
+            for e in range(len(loads[0]))
+        ]
+    return out

@@ -24,6 +24,12 @@ INDEX_HTML = """<!doctype html>
 <li><a href="/render/activations">layer activations</a></li>
 <li><a href="/render/words">nearest-neighbour explorer</a></li>
 </ul>
+<h2>telemetry</h2>
+<ul>
+<li><a href="/metrics">Prometheus metrics</a></li>
+<li><a href="/api/telemetry">telemetry snapshot (JSON)</a></li>
+<li><a href="/api/memory">device memory stats</a></li>
+</ul>
 <h2>api</h2>
 <ul>
 <li><a href="/api/words">word vectors (count)</a></li>
@@ -57,6 +63,15 @@ class UiServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.port: Optional[int] = None
+        self._metrics_registry = None
+
+    # ---- telemetry (ISSUE 2: Prometheus + JSON export on the UI port) ----
+    def attach_metrics(self, registry) -> None:
+        """Serve a telemetry.MetricsRegistry at ``/metrics`` (Prometheus
+        text format) and ``/api/telemetry`` (JSON snapshot). Live view:
+        the registry is read at request time, so a training loop writing
+        into it is immediately visible to scrapers."""
+        self._metrics_registry = registry
 
     # ---- uploads (ref ApiResource: the reference POSTs these; in-process
     # registration serves the same purpose without copying through HTTP) ----
@@ -124,6 +139,30 @@ class UiServer:
                     self._send(200, INDEX_HTML.encode(), "text/html")
                 elif url.path in views.PAGES:
                     self._send(200, views.PAGES[url.path].encode(), "text/html")
+                elif url.path == "/metrics":
+                    from deeplearning4j_tpu.telemetry.prometheus import (
+                        CONTENT_TYPE,
+                        render_prometheus,
+                    )
+
+                    if ui._metrics_registry is None:
+                        self._json({"error": "no metrics registry attached"},
+                                   404)
+                        return
+                    self._send(200,
+                               render_prometheus(
+                                   ui._metrics_registry).encode("utf-8"),
+                               CONTENT_TYPE)
+                elif url.path == "/api/telemetry":
+                    snap = (ui._metrics_registry.snapshot()
+                            if ui._metrics_registry is not None else {})
+                    self._json(snap)
+                elif url.path == "/api/memory":
+                    from deeplearning4j_tpu.utils.profiling import (
+                        device_memory_stats,
+                    )
+
+                    self._json({"devices": device_memory_stats()})
                 elif url.path == "/api/words":
                     self._json({"count": len(ui._words), "words": ui._words[:200]})
                 elif url.path == "/api/nearest":

@@ -38,6 +38,14 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
+def named_scope(name: str):
+    """In-graph twin of ``annotate``: names the ops traced inside the scope
+    so DEVICE timelines (XProf) show the phase — use inside jitted code
+    (ring K/V rotation, ulysses AllToAll, pp stage ticks, blockwise tiles),
+    where the host-side TraceAnnotation would only mark trace time."""
+    return jax.named_scope(name)
+
+
 def device_memory_stats() -> List[Dict]:
     """Per-device live-memory stats (bytes in use / peak / limit where the
     backend reports them). Empty dict per device on backends without

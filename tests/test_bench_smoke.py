@@ -76,6 +76,17 @@ def test_bench_lm_composed_stage_on_cpu():
     assert stage_detail.get("tokens_per_sec", 0) > 0
     dense_detail = det.get("lm_composed_densecore_detail", {})
     assert dense_detail.get("attn_impl") == "dense"
+    # telemetry block (ISSUE 2): the stage A/Bs the metrics-threaded step,
+    # runs a logged window through the JSONL pipeline, and must stay under
+    # the 5% overhead budget at the default fetch interval
+    telemetry = stage_detail.get("telemetry", {})
+    assert telemetry, "lm_composed detail lost its telemetry block"
+    assert telemetry["steps_logged"] > 0
+    summary = telemetry["step_log_summary"]
+    assert "loss" in summary and "grad_norm" in summary
+    assert summary["tokens_per_sec_mean"] > 0
+    assert len(summary["router_load_mean"]) >= 2
+    assert telemetry["overhead_pct"] < 5.0, telemetry
 
 
 def test_bench_skips_stages_past_deadline():

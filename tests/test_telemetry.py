@@ -1,0 +1,658 @@
+"""Unified training telemetry (ISSUE 2): in-graph metrics parity, host
+registry/Prometheus/JSONL semantics, the dp×sp×ep telemetry run, listener
+exception-safety, and the scaleout counter bridges."""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.telemetry import (
+    MetricsRegistry,
+    TrainTelemetry,
+    read_step_log,
+    render_prometheus,
+    summarize_step_log,
+)
+from deeplearning4j_tpu.telemetry.step_log import StepLogWriter
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+V, D, H, E, DFF = 32, 16, 2, 4, 32
+B, T = 4, 16
+
+
+def _bits_equal(a, b):
+    return np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+
+def _tree_bits_equal(ta, tb):
+    la = jax.tree_util.tree_leaves(jax.device_get(ta))
+    lb = jax.tree_util.tree_leaves(jax.device_get(tb))
+    assert len(la) == len(lb)
+    return all(_bits_equal(a, b) for a, b in zip(la, lb))
+
+
+def _lm_data(seed=1, vocab=V, b=B, t=T):
+    toks = jax.random.randint(jax.random.PRNGKey(seed), (b, t + 1), 0, vocab)
+    return toks[:, :-1], toks[:, 1:]
+
+
+# ------------------------------------------------------------- registry ----
+
+class TestRegistry:
+    def test_counter_labels_independent(self):
+        reg = MetricsRegistry()
+        reg.counter("jobs", {"worker": "a"}).inc()
+        reg.counter("jobs", {"worker": "a"}).inc(2)
+        reg.counter("jobs", {"worker": "b"}).inc(5)
+        assert reg.counter("jobs", {"worker": "a"}).value == 3
+        assert reg.counter("jobs", {"worker": "b"}).value == 5
+        with pytest.raises(ValueError):
+            reg.counter("jobs").inc(-1)
+
+    def test_gauge_set_and_inc(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("loss")
+        g.set(2.5)
+        assert reg.gauge("loss").value == 2.5
+        g.inc(-0.5)
+        assert g.value == 2.0
+
+    def test_histogram_cumulative_buckets(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("ms", buckets=(1.0, 10.0, 100.0))
+        for v in (0.5, 5.0, 50.0, 500.0):
+            h.observe(v)
+        snap = h.snapshot()
+        # cumulative le semantics: 1 <=1, 2 <=10, 3 <=100, 4 <=+Inf
+        assert [b["count"] for b in snap["buckets"]] == [1, 2, 3, 4]
+        assert snap["buckets"][-1]["le"] == float("inf")
+        assert snap["count"] == 4
+        assert snap["sum"] == pytest.approx(555.5)
+        assert h.percentile(50) == 10.0
+
+    def test_snapshot_structure(self):
+        reg = MetricsRegistry()
+        reg.counter("c").inc()
+        reg.gauge("g", {"x": "1"}).set(3)
+        reg.histogram("h").observe(2)
+        snap = reg.snapshot()
+        assert snap["counters"][0] == {"name": "c", "labels": {}, "value": 1.0}
+        assert snap["gauges"][0]["labels"] == {"x": "1"}
+        assert snap["histograms"][0]["count"] == 1
+
+
+# ----------------------------------------------------------- prometheus ----
+
+class TestPrometheus:
+    def test_golden_text_format(self):
+        reg = MetricsRegistry()
+        reg.counter("train_steps").inc(3)
+        reg.gauge("train_loss").set(1.5)
+        reg.gauge("router_load", {"expert": "0"}).set(0.25)
+        reg.gauge("router_load", {"expert": "1"}).set(0.75)
+        h = reg.histogram("step_ms", buckets=(10.0, 100.0))
+        h.observe(5.0)
+        h.observe(50.0)
+        expected = (
+            "# TYPE train_steps_total counter\n"
+            "train_steps_total 3\n"
+            "# TYPE router_load gauge\n"
+            'router_load{expert="0"} 0.25\n'
+            'router_load{expert="1"} 0.75\n'
+            "# TYPE train_loss gauge\n"
+            "train_loss 1.5\n"
+            "# TYPE step_ms histogram\n"
+            'step_ms_bucket{le="10"} 1\n'
+            'step_ms_bucket{le="100"} 2\n'
+            'step_ms_bucket{le="+Inf"} 2\n'
+            "step_ms_sum 55\n"
+            "step_ms_count 2\n"
+        )
+        assert render_prometheus(reg) == expected
+
+    def test_name_sanitization_and_label_escaping(self):
+        reg = MetricsRegistry()
+        reg.counter("rounds.worker-0").inc()
+        reg.gauge("g", {"path": 'a"b\nc'}).set(1)
+        txt = render_prometheus(reg)
+        assert "rounds_worker_0_total 1" in txt
+        assert r'path="a\"b\nc"' in txt
+
+
+# -------------------------------------------------------------- step log ----
+
+class TestStepLog:
+    def test_round_trip(self, tmp_path):
+        path = str(tmp_path / "steps.jsonl")
+        with StepLogWriter(path, static={"mesh": "dp2xsp2xep2"}) as w:
+            w.write(0, wall_ms=None, loss=1.5, router_load=[0.5, 0.5])
+            w.write(1, wall_ms=12.5, tokens_per_sec=1000.0, loss=1.25)
+        recs = read_step_log(path)
+        assert [r["step"] for r in recs] == [0, 1]
+        assert recs[0]["mesh"] == "dp2xsp2xep2"
+        assert recs[0]["router_load"] == [0.5, 0.5]
+        assert "wall_ms" not in recs[0] and recs[1]["wall_ms"] == 12.5
+        assert recs[1]["tokens_per_sec"] == 1000.0
+
+    def test_write_after_close_reopens_append(self, tmp_path):
+        path = str(tmp_path / "steps.jsonl")
+        w = StepLogWriter(path)
+        w.write(0, loss=1.0)
+        w.close()
+        w.write(1, loss=0.5)  # listener chains get closed and reused
+        w.close()
+        assert len(read_step_log(path)) == 2
+
+    def test_jax_scalars_and_nonfinite(self, tmp_path):
+        path = str(tmp_path / "steps.jsonl")
+        with StepLogWriter(path) as w:
+            w.write(0, loss=jnp.float32(2.0), bad=float("nan"))
+        rec = read_step_log(path)[0]
+        assert rec["loss"] == 2.0 and rec["bad"] == "nan"
+
+    def test_summarize(self, tmp_path):
+        recs = [
+            {"step": 0, "loss": 2.0, "grad_norm": 1.0,
+             "router_load": [0.4, 0.6]},
+            {"step": 1, "wall_ms": 10.0, "tokens_per_sec": 100.0,
+             "loss": 1.0, "grad_norm": 0.5, "router_load": [0.6, 0.4]},
+        ]
+        s = summarize_step_log(recs)
+        assert s["steps"] == 2
+        assert s["loss"] == {"first": 2.0, "last": 1.0}
+        assert s["wall_ms"]["p50"] == 10.0
+        assert s["router_load_mean"] == [0.5, 0.5]
+        assert summarize_step_log([]) == {"steps": 0}
+
+
+# ------------------------------------------------- in-graph metric parity ----
+
+class TestInGraphParity:
+    def test_lm_step_bit_identical_with_metrics(self):
+        """The metrics-threaded flagship step returns the SAME loss and
+        params as the unthreaded one — 0 ulp on CPU."""
+        from deeplearning4j_tpu.models.transformer_lm import (
+            init_lm_params,
+            make_single_device_train_step,
+        )
+
+        params = init_lm_params(jax.random.PRNGKey(0), V, D, H, E, DFF,
+                                n_layers=2)
+        tk, tg = _lm_data()
+        plain = make_single_device_train_step(H, attn_impl="dense")
+        threaded = make_single_device_train_step(H, attn_impl="dense",
+                                                 with_metrics=True)
+        p0 = p1 = params
+        for _ in range(3):
+            p0, l0 = plain(p0, tk, tg)
+            p1, l1, metrics = threaded(p1, tk, tg)
+            assert _bits_equal(l0, l1)
+        assert _tree_bits_equal(p0, p1)
+        m = jax.device_get(metrics)
+        for key in ("loss", "task_loss", "aux_loss", "grad_norm",
+                    "param_norm", "update_ratio", "router_load"):
+            assert key in m
+        assert m["router_load"].shape == (E,)
+        assert abs(float(m["router_load"].sum()) - 1.0) < 1e-5
+        assert float(m["grad_norm"]) > 0
+        assert 0 < float(m["update_ratio"]) < 1
+
+    def test_trainer_sync_step_bit_identical_with_metrics(self):
+        from deeplearning4j_tpu.nn import functional as F
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.parallel import data_parallel_mesh
+        from deeplearning4j_tpu.parallel.trainer import make_sync_train_step
+
+        conf = (NeuralNetConfiguration.Builder()
+                .n_in(4).n_out(8).activation_function("tanh").lr(0.1)
+                .num_iterations(1).seed(0).list(2)
+                .override(1, layer_type="OUTPUT", n_in=8, n_out=3,
+                          activation_function="softmax",
+                          loss_function="MCXENT")
+                .pretrain(False).backward(True).build())
+        mesh = data_parallel_mesh(8)
+        params = F.init_params(conf, jax.random.PRNGKey(0))
+        states = F.init_train_state(conf, params)
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.rand(16, 4).astype(np.float32))
+        y = jnp.asarray(np.eye(3, dtype=np.float32)[rng.randint(0, 3, 16)])
+        w = jnp.ones((16,), jnp.float32)
+        key = jax.random.PRNGKey(7)
+
+        def copy(t):
+            return jax.tree_util.tree_map(jnp.array, t)
+
+        plain = make_sync_train_step(conf, mesh)
+        threaded = make_sync_train_step(conf, mesh, with_metrics=True)
+        p0, s0, sc0 = plain(copy(params), copy(states), jnp.asarray(0),
+                            x, y, w, key)
+        p1, s1, sc1, metrics = threaded(copy(params), copy(states),
+                                        jnp.asarray(0), x, y, w, key)
+        assert _bits_equal(sc0, sc1)
+        assert _tree_bits_equal(p0, p1)
+        m = jax.device_get(metrics)
+        assert float(m["grad_norm"]) > 0
+        assert float(m["update_ratio"]) > 0
+        assert _bits_equal(m["loss"], np.asarray(sc0, np.float32))
+
+    def test_pipeline_step_bit_identical_with_metrics(self):
+        from deeplearning4j_tpu.models.transformer_lm import (
+            init_lm_params,
+            make_pp_loss,
+            make_pp_stages,
+        )
+        from deeplearning4j_tpu.parallel.pipeline import (
+            make_pipeline_train_step,
+            shard_stage_params,
+            stack_stage_params,
+        )
+
+        params = init_lm_params(jax.random.PRNGKey(0), V, D, H, E, DFF,
+                                n_layers=2)
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                    ("data", "pipe"))
+        per_stage, stage_fn = make_pp_stages(params, H, n_stages=2,
+                                             attn_impl="dense")
+        stacked = shard_stage_params(stack_stage_params(per_stage), mesh,
+                                     "pipe")
+        n_micro, mb = 4, 2
+        toks = jax.random.randint(jax.random.PRNGKey(3),
+                                  (n_micro, mb, T + 1), 0, V)
+        tk, tg = toks[..., :-1], toks[..., 1:]
+
+        def run(with_metrics):
+            loss_fn = make_pp_loss(stage_fn, mesh, "pipe",
+                                   batch_axis="data")
+
+            def pp_loss(y, tgt_mb):
+                logits = y @ params["dec_w"] + params["dec_b"]
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                nll = -jnp.take_along_axis(logp, tgt_mb[..., None],
+                                           -1)[..., 0]
+                return jnp.mean(nll)
+
+            step = make_pipeline_train_step(
+                stage_fn, pp_loss, mesh, "pipe", batch_axis="data",
+                with_metrics=with_metrics)
+            emb = params["embed"][tk]
+            st = jax.tree_util.tree_map(jnp.array, stacked)
+            return step(st, emb, tg)
+
+        p0, l0 = run(False)
+        p1, l1, metrics = run(True)
+        assert _bits_equal(l0, l1)
+        assert _tree_bits_equal(p0, p1)
+        m = jax.device_get(metrics)
+        assert m["microbatch_loss"].shape == (4,)
+        assert float(m["grad_norm"]) > 0
+
+
+# ----------------------------------------------- dp×sp×ep telemetry run ----
+
+class TestComposedTelemetry:
+    def _mesh(self):
+        return Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                    ("data", "sp", "expert"))
+
+    def test_router_load_sums_to_one_per_step(self):
+        from deeplearning4j_tpu.models.transformer_lm import (
+            init_lm_params,
+            make_composed_train_step,
+            shard_lm_batch,
+            shard_lm_params,
+        )
+
+        mesh = self._mesh()
+        params = init_lm_params(jax.random.PRNGKey(0), V, D, H, 2, DFF,
+                                n_layers=2)
+        step = make_composed_train_step(mesh, H, capacity=B * T,
+                                        with_metrics=True)
+        tk, tg = _lm_data()
+        sp = shard_lm_params(params, mesh)
+        stk, stg = shard_lm_batch(tk, tg, mesh)
+        for _ in range(3):
+            sp, loss, metrics = step(sp, stk, stg)
+            jax.block_until_ready(loss)
+            m = jax.device_get(metrics)
+            assert m["router_load"].shape == (2,)
+            assert abs(float(m["router_load"].sum()) - 1.0) < 1e-5
+            assert float(m["grad_norm"]) > 0
+
+    def test_step_log_prometheus_and_memory_endpoints(self, tmp_path):
+        """The acceptance run: dp×sp×ep train with telemetry produces a
+        JSONL step log with loss/grad-norm/tokens-per-sec/router-load per
+        logged step, and the UI serves the same gauges at /metrics plus
+        device memory at /api/memory."""
+        from deeplearning4j_tpu.models.transformer_lm import (
+            init_lm_params,
+            make_composed_train_step,
+            selected_attn_impl,
+            shard_lm_batch,
+            shard_lm_params,
+        )
+        from deeplearning4j_tpu.ui.server import UiServer
+
+        mesh = self._mesh()
+        params = init_lm_params(jax.random.PRNGKey(0), V, D, H, 2, DFF,
+                                n_layers=2)
+        step = make_composed_train_step(mesh, H, capacity=B * T,
+                                        with_metrics=True)
+        tk, tg = _lm_data()
+        sp = shard_lm_params(params, mesh)
+        stk, stg = shard_lm_batch(tk, tg, mesh)
+
+        path = str(tmp_path / "steps.jsonl")
+        reg = MetricsRegistry()
+        session = TrainTelemetry(
+            registry=reg, step_log_path=path, interval=2,
+            tokens_per_step=B * T,
+            static={"mesh": "dp2xsp2xep2",
+                    "attn_impl": selected_attn_impl(T)})
+        n_steps = 5
+        for i in range(n_steps):
+            sp, loss, metrics = step(sp, stk, stg)
+            session.record(i, metrics)
+        session.close()
+
+        recs = read_step_log(path)
+        assert len(recs) == n_steps
+        for i, rec in enumerate(recs):
+            assert rec["step"] == i
+            assert isinstance(rec["loss"], float)
+            assert isinstance(rec["grad_norm"], float)
+            assert abs(sum(rec["router_load"]) - 1.0) < 1e-5
+            assert rec["attn_impl"] in ("dense", "blockwise", "flash")
+            if i > 0:  # first step only arms the clock
+                assert rec["wall_ms"] > 0
+                assert rec["tokens_per_sec"] > 0
+
+        server = UiServer()
+        server.attach_metrics(reg)
+        port = server.start(port=0)
+        try:
+            def get(p):
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}{p}") as r:
+                    return r.headers.get("Content-Type"), r.read().decode()
+
+            ctype, text = get("/metrics")
+            assert ctype.startswith("text/plain")
+            assert "train_loss" in text
+            assert "train_grad_norm" in text
+            assert 'train_router_load{expert="0"}' in text
+            assert f"train_steps_total {n_steps}" in text
+            assert "train_tokens_per_sec" in text
+
+            _, body = get("/api/telemetry")
+            snap = json.loads(body)
+            names = {g["name"] for g in snap["gauges"]}
+            assert {"train_loss", "train_grad_norm",
+                    "train_router_load"} <= names
+
+            _, body = get("/api/memory")
+            mem = json.loads(body)
+            assert len(mem["devices"]) == len(jax.devices())
+            assert all("device" in d for d in mem["devices"])
+        finally:
+            server.stop()
+
+    def test_metrics_endpoint_404_without_registry(self):
+        from deeplearning4j_tpu.ui.server import UiServer
+
+        server = UiServer()
+        port = server.start(port=0)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics")
+            assert e.value.code == 404
+        finally:
+            server.stop()
+
+
+# ------------------------------------------------------- listener safety ----
+
+class _Closeable:
+    def __init__(self, raise_on_call=False):
+        self.calls = 0
+        self.closed = 0
+        self.raise_on_call = raise_on_call
+
+    def __call__(self, model, iteration, score):
+        self.calls += 1
+        if self.raise_on_call:
+            raise RuntimeError("bad listener")
+
+    def close(self):
+        self.closed += 1
+
+
+def _small_net():
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.Builder()
+            .n_in(4).n_out(8).activation_function("tanh").lr(0.1)
+            .num_iterations(3).seed(0).list(2)
+            .override(1, layer_type="OUTPUT", n_in=8, n_out=3,
+                      activation_function="softmax", loss_function="MCXENT")
+            .pretrain(False).backward(True).build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestListenerSafety:
+    def test_bad_listener_does_not_kill_fit(self):
+        from deeplearning4j_tpu.optimize.listeners import (
+            CollectScoresListener,
+        )
+
+        net = _small_net()
+        bad = _Closeable(raise_on_call=True)
+        good = CollectScoresListener()
+        net.set_listeners([bad, good])
+        rng = np.random.RandomState(0)
+        net.fit(rng.rand(12, 4).astype(np.float32),
+                np.eye(3, dtype=np.float32)[rng.randint(0, 3, 12)])
+        assert bad.calls == 3  # kept being called, kept failing
+        assert len(good.scores) == 3  # later listeners still ran
+
+    def test_listeners_closed_on_crash_inside_fit(self):
+        net = _small_net()
+        closeable = _Closeable()
+        net.set_listeners([closeable])
+        with pytest.raises(ValueError, match="No labels"):
+            net.fit(np.random.rand(12, 4).astype(np.float32), None)
+        assert closeable.closed >= 1
+
+    def test_listeners_closed_after_normal_fit(self):
+        net = _small_net()
+        closeable = _Closeable()
+        net.set_listeners([closeable])
+        rng = np.random.RandomState(0)
+        net.fit(rng.rand(12, 4).astype(np.float32),
+                np.eye(3, dtype=np.float32)[rng.randint(0, 3, 12)])
+        assert closeable.closed >= 1
+
+    def test_solver_dispatch_safe_and_closes(self):
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.optimize.solver import Solver
+
+        conf = (NeuralNetConfiguration.Builder()
+                .n_in(2).n_out(2).num_iterations(4).seed(0).build())
+        bad = _Closeable(raise_on_call=True)
+
+        def score_fn(p, key):
+            return jnp.sum(p ** 2)
+
+        solver = Solver(conf, score_fn, listeners=[bad], num_iterations=4)
+        solver.optimize(jnp.ones((3,)))
+        assert bad.calls >= 1
+        assert bad.closed >= 1
+
+    def test_trainer_dispatch_safe(self):
+        from deeplearning4j_tpu.datasets.impl import IrisDataSetIterator
+        from deeplearning4j_tpu.optimize.listeners import (
+            CollectScoresListener,
+        )
+        from deeplearning4j_tpu.parallel import (
+            ParameterAveragingTrainer,
+            data_parallel_mesh,
+        )
+
+        net = _small_net()
+        bad = _Closeable(raise_on_call=True)
+        good = CollectScoresListener()
+        net.set_listeners([bad, good])
+        trainer = ParameterAveragingTrainer(net, data_parallel_mesh(8),
+                                            average_each_iteration=True)
+        it = IrisDataSetIterator(32, 144)
+        trainer.fit_data_set(it)
+        assert bad.calls > 0 and len(good.scores) == bad.calls
+        assert bad.closed >= 1
+
+    def test_profiler_listener_closed_via_chain(self, tmp_path):
+        """ProfilerIterationListener with a window larger than the run: the
+        fit's finally must stop the still-open trace (armed profiler would
+        make the NEXT start_trace raise)."""
+        from deeplearning4j_tpu.utils.profiling import (
+            ProfilerIterationListener,
+        )
+
+        net = _small_net()
+        listener = ProfilerIterationListener(str(tmp_path / "t"), start=1,
+                                             steps=100)
+        net.set_listeners([listener])
+        rng = np.random.RandomState(0)
+        net.fit(rng.rand(12, 4).astype(np.float32),
+                np.eye(3, dtype=np.float32)[rng.randint(0, 3, 12)])
+        assert not listener._active  # window closed by the finally
+        # and the profiler is actually free: a fresh trace can start
+        jax.profiler.start_trace(str(tmp_path / "t2"))
+        jax.profiler.stop_trace()
+
+
+# -------------------------------------------------- timing/tracker bridge ----
+
+class TestTimingListener:
+    def test_percentiles(self, monkeypatch):
+        from deeplearning4j_tpu.optimize.listeners import (
+            TimingIterationListener,
+        )
+
+        listener = TimingIterationListener()
+        clock = iter([0.0, 0.010, 0.030, 0.060, 0.100, 0.200])
+        monkeypatch.setattr("time.perf_counter", lambda: next(clock))
+        for i in range(6):
+            listener(None, i, 0.0)
+        # gaps: 10, 20, 30, 40, 100 ms
+        assert listener.timings_ms == pytest.approx([10, 20, 30, 40, 100])
+        assert listener.p50_ms() == pytest.approx(30)
+        assert listener.p95_ms() == pytest.approx(100)
+        assert TimingIterationListener().p50_ms() == 0.0
+
+    def test_tracker_and_registry_bridge(self):
+        from deeplearning4j_tpu.optimize.listeners import (
+            TimingIterationListener,
+        )
+        from deeplearning4j_tpu.scaleout.statetracker import (
+            InMemoryStateTracker,
+        )
+
+        reg = MetricsRegistry()
+        tracker = InMemoryStateTracker()
+        listener = TimingIterationListener(tracker=tracker, registry=reg)
+        for i in range(4):
+            listener(None, i, 0.1)
+        assert tracker.count("job_ms_total") == pytest.approx(
+            listener.total_ms())
+        assert reg.histogram("iteration_ms").count == 3
+
+    def test_metrics_iteration_listener(self, tmp_path):
+        from deeplearning4j_tpu.optimize.listeners import (
+            MetricsIterationListener,
+        )
+
+        reg = MetricsRegistry()
+        path = str(tmp_path / "iters.jsonl")
+        listener = MetricsIterationListener(registry=reg,
+                                            step_log_path=path)
+        net = _small_net()
+        net.set_listeners([listener])
+        rng = np.random.RandomState(0)
+        net.fit(rng.rand(12, 4).astype(np.float32),
+                np.eye(3, dtype=np.float32)[rng.randint(0, 3, 12)])
+        assert reg.counter("train_iterations_total").value == 3
+        assert reg.gauge("train_score").value > 0
+        recs = read_step_log(path)
+        assert len(recs) == 3 and all("score" in r for r in recs)
+
+
+class TestStateTrackerMirror:
+    def test_increment_mirrors_into_registry(self):
+        from deeplearning4j_tpu.scaleout.statetracker import (
+            InMemoryStateTracker,
+        )
+
+        reg = MetricsRegistry()
+        tracker = InMemoryStateTracker(metrics_registry=reg)
+        tracker.increment("job_ms_total", 12.5)
+        tracker.increment("jobs_done")
+        tracker.increment("rounds.w-0")
+        assert reg.counter("job_ms_total").value == 12.5
+        assert reg.counter("jobs_done").value == 1
+        # dotted key renders sanitized
+        assert "rounds_w_0_total 1" in render_prometheus(reg)
+
+
+# ------------------------------------------------------------------ tools ----
+
+class TestTelemetryReport:
+    def _write_log(self, tmp_path):
+        path = str(tmp_path / "steps.jsonl")
+        with StepLogWriter(path) as w:
+            w.write(0, loss=2.0, grad_norm=1.5, router_load=[0.5, 0.5])
+            w.write(1, wall_ms=10.0, tokens_per_sec=6400.0, loss=1.5,
+                    grad_norm=1.2, router_load=[0.4, 0.6])
+            w.write(2, wall_ms=12.0, tokens_per_sec=5333.3, loss=1.2,
+                    grad_norm=1.1, router_load=[0.6, 0.4])
+        return path
+
+    def test_report_table(self, tmp_path):
+        path = self._write_log(tmp_path)
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "telemetry_report.py"), path],
+            capture_output=True, text=True, timeout=60, cwd=REPO)
+        assert out.returncode == 0, out.stderr
+        assert "steps" in out.stdout
+        assert "2.0 -> 1.2" in out.stdout  # loss first -> last
+        assert "tokens/s" in out.stdout
+        assert "e0=0.5" in out.stdout
+
+    def test_report_json(self, tmp_path):
+        path = self._write_log(tmp_path)
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "telemetry_report.py"), path,
+             "--json"],
+            capture_output=True, text=True, timeout=60, cwd=REPO)
+        assert out.returncode == 0, out.stderr
+        summary = json.loads(out.stdout)
+        assert summary["steps"] == 3
+        assert summary["loss"] == {"first": 2.0, "last": 1.2}
+
+    def test_report_missing_file(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "telemetry_report.py"),
+             str(tmp_path / "nope.jsonl")],
+            capture_output=True, text=True, timeout=60, cwd=REPO)
+        assert out.returncode == 2

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Render a telemetry JSONL step log into a throughput/grad-norm summary.
+
+Usage:
+    python tools/telemetry_report.py PATH/steps.jsonl [--json]
+
+Reads the step-event log a TrainTelemetry session (or
+MetricsIterationListener) wrote and prints an aligned summary table:
+step count, wall-clock p50/p95/mean, mean tokens/s, loss and grad-norm
+first→last, and the mean per-expert router load. ``--json`` emits the raw
+summary dict instead (CI-friendly).
+
+The aggregation itself lives in telemetry/step_log.summarize_step_log so
+bench.py's lm_composed stage and this report can never disagree.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu.telemetry.step_log import (  # noqa: E402
+    read_step_log,
+    summarize_step_log,
+)
+
+
+def format_report(summary: dict, path: str) -> str:
+    rows = [("steps", str(summary.get("steps", 0)))]
+    wall = summary.get("wall_ms")
+    if wall:
+        rows.append(("wall ms (p50 / p95 / mean)",
+                     f"{wall['p50']} / {wall['p95']} / {wall['mean']}"))
+    if "tokens_per_sec_mean" in summary:
+        rows.append(("tokens/s (mean)", str(summary["tokens_per_sec_mean"])))
+    for key in ("loss", "score", "grad_norm", "param_norm", "update_ratio"):
+        if key in summary:
+            s = summary[key]
+            rows.append((f"{key} (first -> last)",
+                         f"{s['first']} -> {s['last']}"))
+    if "router_load_mean" in summary:
+        load = summary["router_load_mean"]
+        rows.append(("router load (mean/expert)",
+                     " ".join(f"e{i}={v}" for i, v in enumerate(load))))
+    width = max(len(r[0]) for r in rows)
+    lines = [f"telemetry report — {path}", "-" * (width + 24)]
+    lines += [f"{name:<{width}}  {value}" for name, value in rows]
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("log", help="JSONL step log path")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as JSON instead of a table")
+    args = ap.parse_args(argv)
+    if not os.path.isfile(args.log):
+        print(f"no such step log: {args.log}", file=sys.stderr)
+        return 2
+    records = read_step_log(args.log)
+    summary = summarize_step_log(records)
+    if args.json:
+        print(json.dumps(summary, indent=1))
+    else:
+        print(format_report(summary, args.log))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
