@@ -128,20 +128,27 @@ class KMeansClustering:
         x = jnp.asarray(data, jnp.float32)
         centers = jnp.asarray(self._kpp_init(data), jnp.float32)
 
-        self.iteration_costs = []
         prev_cost = None
         assign = None
+        costs_dev = []  # fixed-iteration mode: costs stay on device
         for _ in range(self.max_iterations):
             centers, assign, _counts, cost = _lloyd_step(
                 x, centers, self.k, self.distance
             )
-            cost = float(cost)
-            self.iteration_costs.append(cost)
-            if prev_cost is not None and self.min_variation_rate is not None:
+            if self.min_variation_rate is None:
+                # fixed iteration count: no host decision needed per step, so
+                # dispatch all Lloyd iterations back-to-back and fetch the
+                # cost trajectory once after the loop
+                costs_dev.append(cost)
+                continue
+            cost = float(cost)  # graftlint: allow[jit-host-sync] convergence mode: the stop decision needs the host-side cost each iteration (ref VarianceVariationCondition)
+            costs_dev.append(cost)
+            if prev_cost is not None:
                 variation = abs(prev_cost - cost) / max(abs(prev_cost), 1e-12)
                 if variation < self.min_variation_rate:
                     break
             prev_cost = cost
+        self.iteration_costs = [float(c) for c in jax.device_get(costs_dev)]
 
         centers_np = np.asarray(centers)
         assign_np = np.asarray(assign)

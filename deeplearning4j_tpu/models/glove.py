@@ -153,7 +153,10 @@ class Glove:
         self.losses = []
         for _ in range(self.iterations):
             perm = shuffle_rng.permutation(n)
-            epoch_loss = 0.0
+            # epoch loss accumulates ON DEVICE — a float(loss) per batch
+            # would sync host<->device every step and serialize dispatch
+            # (graftlint jit-host-sync); one fetch per epoch is enough
+            epoch_loss = None
             for start in range(0, n, bsz):
                 sl = perm[start : start + bsz]
                 wt = np.ones(len(sl), np.float32)
@@ -167,8 +170,9 @@ class Glove:
                     jnp.asarray(logx[sl]), jnp.asarray(fx[sl]),
                     jnp.asarray(wt), jnp.float32(self.lr),
                 )
-                epoch_loss += float(loss)
-            self.losses.append(epoch_loss)
+                epoch_loss = loss if epoch_loss is None else epoch_loss + loss
+            self.losses.append(
+                0.0 if epoch_loss is None else float(epoch_loss))
         self.syn0 = np.asarray(w)
         self.bias = np.asarray(b)
 

@@ -184,7 +184,11 @@ class RNTN:
         batches = self._prepare(trees)
         self.losses = []
         for _ in range(self.iterations):
-            epoch = 0.0
+            # accumulate the epoch loss ON DEVICE: a float(loss) per bucket
+            # would round-trip host<->device every step and serialize the
+            # AdaGrad dispatch pipeline (graftlint jit-host-sync); one fetch
+            # per epoch keeps the listener-visible trajectory identical
+            epoch = None
             for leaf, mrg, mmask, lbls, smask in batches:
                 params, hist, loss = _rntn_batch_step(
                     params, hist,
@@ -192,8 +196,8 @@ class RNTN:
                     jnp.asarray(lbls), jnp.asarray(smask),
                     self.lr, self.l2,
                 )
-                epoch += float(loss)
-            self.losses.append(epoch)
+                epoch = loss if epoch is None else epoch + loss
+            self.losses.append(0.0 if epoch is None else float(epoch))
         self.params = {k: np.asarray(v) for k, v in params.items()}
 
     # ---- inference ----

@@ -312,12 +312,19 @@ def shard_lm_batch(tokens: Array, targets: Array, mesh: Mesh) -> tuple:
     return jax.device_put(tokens, sh), jax.device_put(targets, sh)
 
 
-def _make_sgd_step(loss_fn, lr: float, with_metrics: bool):
+def _make_sgd_step(loss_fn, lr: float, with_metrics: bool,
+                   donate: bool = False):
     """jitted SGD step; with metrics the loss fn returns (loss, aux) and the
     step appends the grad/param-norm block — the loss+grad graph itself is
-    the SAME ops either way (bit-parity pinned in tests/test_telemetry.py)."""
+    the SAME ops either way (bit-parity pinned in tests/test_telemetry.py).
+
+    ``donate=True`` donates the incoming params buffers to the update
+    (halves peak param HBM for hot training loops: bench); the default
+    keeps them alive because parity oracles and tests call the step with a
+    pytree they reuse afterwards."""
+    donate_argnums = (0,) if donate else ()
     if not with_metrics:
-        @jax.jit
+        @partial(jax.jit, donate_argnums=donate_argnums)
         def step(params, tokens, targets):
             loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
             return jax.tree_util.tree_map(lambda p, g: p - lr * g,
@@ -327,7 +334,7 @@ def _make_sgd_step(loss_fn, lr: float, with_metrics: bool):
 
     from deeplearning4j_tpu.telemetry.metrics import train_step_metrics
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=donate_argnums)
     def step(params, tokens, targets):
         (loss, metrics), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params, tokens, targets)
@@ -344,7 +351,8 @@ def make_composed_train_step(mesh: Mesh, n_heads: int, capacity: int,
                              lr: float = 0.1, top_k: int = 2,
                              aux_weight: float = 1e-2,
                              attn_impl: Optional[str] = None,
-                             with_metrics: bool = False):
+                             with_metrics: bool = False,
+                             donate: bool = False):
     """SGD step over the composed mesh: step(params, tokens, targets) ->
     (new_params, loss). Shard inputs with shard_lm_params/shard_lm_batch
     first; GSPMD + the shard_map transposes insert every collective
@@ -359,20 +367,21 @@ def make_composed_train_step(mesh: Mesh, n_heads: int, capacity: int,
     loss_fn = composed_loss_fn(mesh, n_heads, capacity, top_k, aux_weight,
                                attn_impl=attn_impl,
                                with_metrics=with_metrics)
-    return _make_sgd_step(loss_fn, lr, with_metrics)
+    return _make_sgd_step(loss_fn, lr, with_metrics, donate=donate)
 
 
 def make_single_device_train_step(n_heads: int, lr: float = 0.1,
                                   top_k: int = 2, aux_weight: float = 1e-2,
                                   attn_impl: Optional[str] = None,
-                                  with_metrics: bool = False):
+                                  with_metrics: bool = False,
+                                  donate: bool = False):
     """The dense twin of make_composed_train_step (parity oracle when
     called with ``attn_impl="dense"``; the flagship single-chip bench path
-    with the default auto core). ``with_metrics`` as on the composed
-    builder."""
+    with the default auto core). ``with_metrics``/``donate`` as on the
+    composed builder (bench hot loops pass donate=True)."""
     loss_fn = dense_loss_fn(n_heads, top_k, aux_weight, attn_impl=attn_impl,
                             with_metrics=with_metrics)
-    return _make_sgd_step(loss_fn, lr, with_metrics)
+    return _make_sgd_step(loss_fn, lr, with_metrics, donate=donate)
 
 
 # ----------------------------------------------------------------- dp×pp ----

@@ -537,6 +537,14 @@ class Word2Vec:
         self._neg_table_dev = None   # unigram^0.75 table, uploaded once
         self._hs_tabs_dev = None     # Huffman path tables, uploaded once
 
+    def block_until_ready(self) -> None:
+        """Timing fence: block until all pending device-side training on the
+        embedding tables has completed (without downloading them — reading
+        ``lookup_table`` does that). Benches must call this before stopping
+        a clock around fit()."""
+        if self._syn_dev is not None:
+            jax.block_until_ready(self._syn_dev)
+
     @property
     def lookup_table(self) -> Optional[InMemoryLookupTable]:
         """The host-side embedding table (ref: Word2Vec.lookupTable). Reading
@@ -868,7 +876,7 @@ class Word2Vec:
         neg_group = 0
         if self.shared_negatives and self.negative > 0:
             neg_group = neg_group_size(bsz, self.shared_negatives)
-        self._timings["prep"] += _time.perf_counter() - t0
+        self._timings["prep"] += _time.perf_counter() - t0  # graftlint: allow[untimed-dispatch] host-phase split timer; device share is measured separately as drain
 
         pairs_total = None
         for e in range(iters):
@@ -881,7 +889,7 @@ class Word2Vec:
                              self.lr * (1.0 - np.minimum(frac, 1.0))
                              ).astype(np.float32)
             lrs_j = jnp.asarray(lrs)
-            self._timings["prep"] += _time.perf_counter() - t0
+            self._timings["prep"] += _time.perf_counter() - t0  # graftlint: allow[untimed-dispatch] host-phase split timer; device share is measured separately as drain
             if self.negative > 0:
                 key, sub = jax.random.split(key)
                 syn0, syn1neg, _, wtot = _sgns_device_epoch(
@@ -929,7 +937,7 @@ class Word2Vec:
             if n_pairs:
                 perm = rng.permutation(n_pairs)
                 centers, contexts = centers[perm], contexts[perm]
-            self._timings["pairgen"] += _time.perf_counter() - t0
+            self._timings["pairgen"] += _time.perf_counter() - t0  # graftlint: allow[untimed-dispatch] host-phase split timer; device share is measured separately as drain
             if total_pairs is None:
                 total_pairs = max(n_pairs, 1) * max(self.iterations, 1)
 
@@ -961,7 +969,7 @@ class Word2Vec:
                         jnp.float32(lr),
                     )
                 pairs_seen += n_real
-                self._timings["prep"] += _time.perf_counter() - t0
+                self._timings["prep"] += _time.perf_counter() - t0  # graftlint: allow[untimed-dispatch] host-phase split timer; device share is measured separately as drain
                 self._timings["dispatches"] += 1
         return syn0, syn1, syn1neg, pairs_seen
 

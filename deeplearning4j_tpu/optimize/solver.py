@@ -23,6 +23,7 @@ Parameters travel as pytrees; line-search solvers flatten to one vector
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Callable, List, Optional, Sequence
 
 import jax
@@ -195,7 +196,10 @@ class Solver:
     def _iteration_gd(self, params, key):
         state = init_updater_state(params)
 
-        @jax.jit
+        # donation declined deliberately: callers (MultiLayerNetwork,
+        # listeners, pretrain paths) retain references into the incoming
+        # params pytree across iterations
+        @partial(jax.jit, donate_argnums=())
         def step(params, state, iteration, key):
             score, grads = self._value_and_grad(params, key)
             update, state = apply_updater(self.conf, iteration, grads, params, state)
@@ -206,7 +210,7 @@ class Solver:
         for i in range(self.num_iterations):
             key, sub = jax.random.split(key)
             params, state, score = step(params, state, jnp.asarray(i), sub)
-            score = float(score)
+            score = float(score)  # graftlint: allow[jit-host-sync] listener/early-stop contract: ScoreIterationListener and _should_stop need the host score every iteration
             self._notify(i, score)
             if self._should_stop(score, old_score, float("inf")):
                 break

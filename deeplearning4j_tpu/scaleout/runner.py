@@ -121,7 +121,7 @@ class LocalDistributedRunner:
         performer: WorkerPerformer = self.performers[worker_id]
         t0 = time.perf_counter()
         performer.perform(job)
-        self.tracker.increment("job_ms_total",
+        self.tracker.increment("job_ms_total",  # graftlint: allow[untimed-dispatch] heartbeat counter, not a bench: perform() ends in the performer's own score fetch
                                (time.perf_counter() - t0) * 1000.0)
         self.tracker.add_update(worker_id, job)
         self._update_arrived.set()  # wake the async master's heartbeat

@@ -26,7 +26,7 @@ class InvertedIndex:
         doc_id = len(self._docs)
         toks = list(tokens)
         self._docs.append(toks)
-        for t in set(toks):
+        for t in sorted(set(toks)):  # sorted: deterministic index order across processes
             self._postings[t].append(doc_id)
         return doc_id
 

@@ -1,0 +1,147 @@
+"""Retrace guard: fail when a step recompiles beyond its pinned budget.
+
+The silent killer graftlint's static rules cannot see is *shape/weak-type
+drift*: a python scalar where an array was traced, a batch that changes
+size, a donated-buffer layout flip — and suddenly every training step
+pays an XLA compile. On a fast chip that turns a 2 ms step into seconds
+without any error. This module counts real XLA backend compilations via
+``jax.monitoring`` (the ``/jax/core/compile/backend_compile_duration``
+event fires exactly once per backend compile; older jaxlibs fall back to
+the ``/jax/compilation_cache/compile_requests_use_cache`` event, and as a
+last resort to ``jax_log_compiles`` log capture) and raises when a guarded
+region compiles more than its budget.
+
+Usage (context manager)::
+
+    step = make_single_device_train_step(heads)
+    step(params, tk, tg)                      # warmup: compiles once
+    with retrace_guard(0, label="lm_composed steady state"):
+        for _ in range(5):
+            params, loss = step(params, tk, tg)   # any retrace -> fail
+
+tests/conftest.py exposes the same object as the ``retrace_budget``
+pytest fixture; tests/test_retrace_guard.py pins compile budgets for the
+composed LM / pipeline / DP-sync steps.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+__all__ = ["RetraceBudgetExceeded", "retrace_guard", "compiles_so_far"]
+
+
+class RetraceBudgetExceeded(AssertionError):
+    """A guarded region compiled more XLA programs than its pinned budget."""
+
+
+_lock = threading.Lock()
+_counter = {"n": 0}
+_installed = {"mode": None}
+
+# one real XLA compile -> exactly one of these fires
+_DURATION_EVENT_SUFFIX = "backend_compile_duration"
+_CACHE_EVENT = "/jax/compilation_cache/compile_requests_use_cache"
+
+
+def _on_duration(name: str, secs: float, **kw) -> None:
+    if name.endswith(_DURATION_EVENT_SUFFIX):
+        with _lock:
+            _counter["n"] += 1
+
+
+def _on_event(name: str, **kw) -> None:
+    if name == _CACHE_EVENT:
+        with _lock:
+            _counter["n"] += 1
+
+
+class _LogCompilesHandler(logging.Handler):
+    """jax_log_compiles capture — last-resort counter for jaxlibs whose
+    monitoring module predates the compile events."""
+
+    def emit(self, record: logging.LogRecord) -> None:
+        if "Compiling" in record.getMessage():
+            with _lock:
+                _counter["n"] += 1
+
+
+def _install() -> str:
+    """Register the process-wide compile listener once; returns the mode
+    actually installed ('duration' | 'event' | 'log')."""
+    with _lock:
+        if _installed["mode"] is not None:
+            return _installed["mode"]
+    import jax
+
+    mode = None
+    mon = getattr(jax, "monitoring", None)
+    if mon is not None and hasattr(mon, "register_event_duration_secs_listener"):
+        mon.register_event_duration_secs_listener(_on_duration)
+        mode = "duration"
+    elif mon is not None and hasattr(mon, "register_event_listener"):
+        mon.register_event_listener(_on_event)
+        mode = "event"
+    else:
+        jax.config.update("jax_log_compiles", True)
+        handler = _LogCompilesHandler()
+        for logger_name in ("jax._src.dispatch",
+                            "jax._src.interpreters.pxla"):
+            logging.getLogger(logger_name).addHandler(handler)
+        mode = "log"
+    with _lock:
+        _installed["mode"] = mode
+    return mode
+
+
+def compiles_so_far() -> int:
+    """Process-wide XLA compile count since the guard was first installed
+    (monotonic; meaningful as a delta, which is what retrace_guard takes)."""
+    _install()
+    with _lock:
+        return _counter["n"]
+
+
+class retrace_guard:
+    """Context manager asserting at most ``budget`` XLA compilations happen
+    inside the block.
+
+    ``budget=0`` pins a steady-state region (a warmed-up train step must
+    never retrace); a positive budget pins a cold region's compile count
+    (e.g. "first step compiles the train step and its data transfers, and
+    nothing else"). The count is process-wide — don't run guarded regions
+    concurrently in threads.
+    """
+
+    def __init__(self, budget: int, label: str = ""):
+        self.budget = int(budget)
+        self.label = label
+        self.count = 0
+        self._start = 0
+
+    def __enter__(self) -> "retrace_guard":
+        _install()
+        self._start = compiles_so_far()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.count = compiles_so_far() - self._start
+        if exc_type is None and self.count > self.budget:
+            what = f" [{self.label}]" if self.label else ""
+            raise RetraceBudgetExceeded(
+                f"retrace budget exceeded{what}: {self.count} XLA "
+                f"compilation(s) in a region pinned to {self.budget}. "
+                "Likely shape/weak-type drift is recompiling the step per "
+                "call (python scalar vs array argument, changing batch "
+                "shape, donation layout flip). Pin the input shapes/dtypes "
+                "— or raise the budget deliberately if the new compiles "
+                "are intended.")
+        return False
+
+
+def pytest_fixture():
+    """Factory for the ``retrace_budget`` fixture (registered in
+    tests/conftest.py): yields the retrace_guard class itself so tests
+    write ``with retrace_budget(0, label=...):``."""
+    return retrace_guard

@@ -9,8 +9,20 @@ override must go through jax.config before first backend use.
 """
 
 import jax
+import pytest
 
 from deeplearning4j_tpu.compat import set_host_device_count
 
 jax.config.update("jax_platforms", "cpu")
 set_host_device_count(8)
+
+
+@pytest.fixture
+def retrace_budget():
+    """The utils.retrace_guard context manager as a fixture: pin a region's
+    XLA compile budget with ``with retrace_budget(0, label="..."): ...`` —
+    any retrace beyond the budget fails the test (shape/weak-type drift
+    can never silently recompile a warmed step per call again)."""
+    from deeplearning4j_tpu.utils.retrace_guard import retrace_guard
+
+    return retrace_guard

@@ -6,6 +6,8 @@ were never composed; round-6: the BLOCKWISE flash core now runs inside
 every composed path via the attn_impl seam, and the flagship is
 multi-block)."""
 
+import contextlib
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -23,6 +25,7 @@ from deeplearning4j_tpu.models.transformer_lm import (
     shard_lm_batch,
     shard_lm_params,
 )
+from deeplearning4j_tpu.utils.retrace_guard import retrace_guard
 
 V, D, H, E, DFF = 32, 16, 2, 4, 32
 B, T = 4, 16
@@ -59,9 +62,16 @@ def _run_parity(mesh, capacity, atol, steps=3, n_experts=E, n_layers=1,
     ref_step = make_single_device_train_step(H, attn_impl="dense")
     ref_params = params
     for i in range(steps):
-        sharded, loss = step(sharded, stoks, stgts)
-        jax.block_until_ready(loss)  # serialize: XLA CPU rendezvous quirk
-        ref_params, ref_loss = ref_step(ref_params, toks, tgts)
+        # after the first (compiling) step, a warmed composed step must
+        # never retrace — per-step recompiles are exactly the drift class
+        # the retrace guard exists to catch (utils/retrace_guard.py)
+        guard = (contextlib.nullcontext() if i == 0 else
+                 retrace_guard(0, label=f"composed {mesh.axis_names} "
+                                        f"step {i}"))
+        with guard:
+            sharded, loss = step(sharded, stoks, stgts)
+            jax.block_until_ready(loss)  # serialize: XLA CPU rendezvous quirk
+            ref_params, ref_loss = ref_step(ref_params, toks, tgts)
         assert abs(float(loss) - float(ref_loss)) < atol, (
             i, float(loss), float(ref_loss))
     _assert_tree_close(jax.device_get(sharded), jax.device_get(ref_params),
@@ -206,14 +216,30 @@ def _pp_parity(n_layers, n_stages, attn_impl=None, steps=4):
     toks_flat = toks_mbs.reshape(-1, T)
     tgt_flat = tgt_mbs.reshape(-1, T)
     jax.block_until_ready(pipe_loss(trained, toks_mbs, tgt_mbs))
+    # jit the grad steps ONCE: the retrace guard exposed that un-jitted
+    # value_and_grad(pipe_loss) re-traced and re-compiled ~470 op-level
+    # programs EVERY iteration (nothing cached across calls) — the exact
+    # failure class the guard exists for
+    pipe_vg = jax.jit(jax.value_and_grad(pipe_loss))
+    seq_vg = jax.jit(jax.value_and_grad(seq_loss))
     losses_p, losses_s = [], []
-    for _ in range(steps):
-        lp, gp = jax.value_and_grad(pipe_loss)(trained, toks_mbs, tgt_mbs)
-        trained = jax.tree_util.tree_map(lambda p, g: p - lr * g, trained, gp)
-        jax.block_until_ready(lp)
-        ls, gs = jax.value_and_grad(seq_loss)(seq_params, toks_flat, tgt_flat)
-        seq_params = jax.tree_util.tree_map(
-            lambda p, g: p - lr * g, seq_params, gs)
+    for i in range(steps):
+        # iteration 0 compiles the grad programs; iteration 1 compiles once
+        # more against the committed shardings the first update produced
+        # (host-placed embed/decoder args became device-committed outputs).
+        # From iteration 2 the staged step must be retrace-free (pinned:
+        # shape drift through the pipeline schedule would recompile every
+        # tick).
+        guard = (contextlib.nullcontext() if i < 2 else
+                 retrace_guard(0, label=f"dp×pp L={n_layers} step {i}"))
+        with guard:
+            lp, gp = pipe_vg(trained, toks_mbs, tgt_mbs)
+            trained = jax.tree_util.tree_map(lambda p, g: p - lr * g,
+                                             trained, gp)
+            jax.block_until_ready(lp)
+            ls, gs = seq_vg(seq_params, toks_flat, tgt_flat)
+            seq_params = jax.tree_util.tree_map(
+                lambda p, g: p - lr * g, seq_params, gs)
         losses_p.append(float(lp))
         losses_s.append(float(ls))
     np.testing.assert_allclose(losses_p, losses_s, atol=1e-5, rtol=1e-5)

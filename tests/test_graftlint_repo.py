@@ -1,0 +1,77 @@
+"""Tier-1 repo gate: graftlint over the whole package must report ZERO
+findings outside the checked-in baseline, the baseline must be fully
+justified and non-stale, and the standalone CLI must agree."""
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.lint_gate import (  # noqa: E402
+    BASELINE_PATH,
+    DEFAULT_TARGETS,
+    REPO_ROOT,
+    run_gate,
+)
+from tools.graftlint import load_baseline  # noqa: E402
+
+
+def test_repo_zero_nonbaselined_findings():
+    fresh, stale, all_findings = run_gate()
+    msg = "\n\n".join(f.render() for f in fresh)
+    assert not fresh, (
+        f"graftlint found {len(fresh)} non-baselined finding(s) — fix them "
+        f"or add a justified baseline/inline allow:\n\n{msg}")
+    # the gate is doing real work, not matching an empty tree
+    assert len(all_findings) > 0, "baselined findings should exist"
+
+
+def test_baseline_has_no_stale_entries():
+    _fresh, stale, _all = run_gate()
+    assert not stale, (
+        "stale baseline entries (the code they matched was fixed) — run "
+        f"`python tools/lint_gate.py --update-baseline` to prune: {stale}")
+
+
+def test_baseline_entries_all_justified():
+    entries = load_baseline(BASELINE_PATH)
+    assert entries, "expected a non-empty baseline"
+    for e in entries:
+        assert e["why"].strip(), f"baseline entry without why: {e}"
+        assert not e["why"].startswith("FIXME"), (
+            f"unjustified baseline entry (placeholder why): {e}")
+
+
+def test_default_targets_cover_the_package():
+    assert "deeplearning4j_tpu" in DEFAULT_TARGETS
+    assert "bench.py" in DEFAULT_TARGETS
+    assert "scaling_bench.py" in DEFAULT_TARGETS
+
+
+def test_cli_json_gate_is_clean():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "lint_gate.py"),
+         "--json"],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["findings"] == []
+    assert payload["stale_baseline_entries"] == []
+    assert payload["total_findings_including_baselined"] > 0
+
+
+def test_cli_detects_a_planted_finding(tmp_path):
+    bad = tmp_path / "planted.py"
+    bad.write_text(
+        "import jax\n\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    return float(x.sum())\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "lint_gate.py"),
+         "--no-baseline", str(bad)],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
+    assert out.returncode == 1
+    assert "jit-host-sync" in out.stdout

@@ -1,0 +1,311 @@
+"""Golden fixtures for every graftlint rule: one known-bad and one
+known-clean snippet each, pinned by rule id. These are the rule-level
+contract; tests/test_graftlint_repo.py is the repo-level gate."""
+
+import os
+import sys
+import textwrap
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.graftlint import lint_source  # noqa: E402
+
+
+def _rules_hit(src: str, path: str = "fixture.py"):
+    return {f.rule for f in lint_source(textwrap.dedent(src), path)}
+
+
+# ------------------------------------------------------------ jit-host-sync ----
+
+def test_jit_host_sync_bad_inside_jit():
+    src = """
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def step(params, x):
+        y = params @ x
+        norm = float(y.sum())          # host sync inside traced code
+        host = np.asarray(y)           # materializes inside traced code
+        return y / norm, host
+    """
+    assert "jit-host-sync" in _rules_hit(src)
+
+
+def test_jit_host_sync_bad_scan_body():
+    src = """
+    import jax
+
+    def epoch(params, xs):
+        def body(carry, x):
+            s = carry + x.sum().item()   # .item() in a lax.scan body
+            return s, s
+        return jax.lax.scan(body, params, xs)
+    """
+    assert "jit-host-sync" in _rules_hit(src)
+
+
+def test_jit_host_sync_bad_host_loop_fetch():
+    src = """
+    import jax
+
+    @jax.jit
+    def train_step(params, x):
+        return params - 0.1 * x, (params * x).sum()
+
+    def fit(params, batches):
+        total = 0.0
+        for x in batches:
+            params, loss = train_step(params, x)
+            total += float(loss)       # per-step fetch serializes dispatch
+        return params, total
+    """
+    assert "jit-host-sync" in _rules_hit(src)
+
+
+def test_jit_host_sync_clean():
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(params, x):
+        y = params @ x
+        return y / jnp.sum(y)
+
+    def fit(params, batches):
+        losses = []
+        for x in batches:
+            params, loss = step(params, x)
+            losses.append(loss)        # stays on device
+        return params, [float(l) for l in jax.device_get(losses)]
+    """
+    assert "jit-host-sync" not in _rules_hit(src)
+
+
+# --------------------------------------------------------- untimed-dispatch ----
+
+def test_untimed_dispatch_bad():
+    src = """
+    import time
+
+    def bench(step, params, x):
+        t0 = time.perf_counter()
+        for _ in range(10):
+            params, loss = step(params, x)
+        return time.perf_counter() - t0   # clock stops at enqueue
+    """
+    assert "untimed-dispatch" in _rules_hit(src)
+
+
+def test_untimed_dispatch_clean_block_until_ready():
+    src = """
+    import time
+    import jax
+
+    def bench(step, params, x):
+        t0 = time.perf_counter()
+        for _ in range(10):
+            params, loss = step(params, x)
+        jax.block_until_ready(params)
+        return time.perf_counter() - t0
+    """
+    assert "untimed-dispatch" not in _rules_hit(src)
+
+
+def test_untimed_dispatch_clean_scalar_fetch():
+    src = """
+    import time
+
+    def bench(step, params, x):
+        t0 = time.perf_counter()
+        for _ in range(10):
+            params, loss = step(params, x)
+        last = float(loss)            # a device->host fetch is a true sync
+        return time.perf_counter() - t0
+    """
+    assert "untimed-dispatch" not in _rules_hit(src)
+
+
+# --------------------------------------------------------------- prng-reuse ----
+
+def test_prng_reuse_bad_double_draw():
+    src = """
+    import jax
+
+    def init(key):
+        w1 = jax.random.normal(key, (4, 4))
+        w2 = jax.random.normal(key, (4, 4))   # same key, same weights
+        return w1, w2
+    """
+    assert "prng-reuse" in _rules_hit(src)
+
+
+def test_prng_reuse_bad_loop_without_advance():
+    src = """
+    import jax
+
+    def fit(step, params, key):
+        key = jax.random.fold_in(key, 0)
+        for i in range(10):
+            params = step(params, key)   # identical randomness every step
+        return params
+    """
+    assert "prng-reuse" in _rules_hit(src)
+
+
+def test_prng_reuse_clean_split_and_branches():
+    src = """
+    import jax
+
+    def fit(step, params, key):
+        for i in range(10):
+            key, sub = jax.random.split(key)
+            params = step(params, sub)
+        return params
+
+    def init(key, kind):
+        if kind == "normal":
+            return jax.random.normal(key, (4,))
+        return jax.random.uniform(key, (4,))   # other arm: exclusive
+    """
+    assert "prng-reuse" not in _rules_hit(src)
+
+
+# -------------------------------------------------------------- stray-debug ----
+
+def test_stray_debug_bad():
+    src = """
+    import jax
+
+    @jax.jit
+    def step(params, x):
+        loss = (params * x).sum()
+        print("loss", loss)            # fires at trace time only
+        jax.debug.print("loss {}", loss)
+        return loss
+    """
+    assert "stray-debug" in _rules_hit(src)
+
+
+def test_stray_debug_clean_host_side():
+    src = """
+    import jax
+
+    @jax.jit
+    def step(params, x):
+        return (params * x).sum()
+
+    def fit(params, x):
+        loss = step(params, x)
+        print("loss", float(loss))     # host-side logging is fine
+        return loss
+    """
+    assert "stray-debug" not in _rules_hit(src)
+
+
+# ------------------------------------------------------------ nondet-pytree ----
+
+def test_nondet_pytree_bad():
+    src = """
+    def build_params(names, init):
+        return {n: init(n) for n in set(names)}   # nondeterministic order
+    """
+    assert "nondet-pytree" in _rules_hit(src)
+
+
+def test_nondet_pytree_clean_sorted():
+    src = """
+    def build_params(names, init):
+        return {n: init(n) for n in sorted(set(names))}
+    """
+    assert "nondet-pytree" not in _rules_hit(src)
+
+
+# -------------------------------------------------------- env-read-in-trace ----
+
+def test_env_read_bad():
+    src = """
+    import os
+
+    def configure():
+        return os.environ.get("MY_RANDOM_KNOB", "0") == "1"
+    """
+    assert "env-read-in-trace" in _rules_hit(src)
+
+
+def test_env_read_clean_blessed():
+    src = """
+    import os
+
+    ATTN_ENV = "DL4J_TPU_ATTN_IMPL"
+
+    def configure():
+        a = os.environ.get("DL4J_TPU_FOO")     # blessed namespace literal
+        b = os.environ.get(ATTN_ENV)           # blessed via in-file constant
+        return a, b
+    """
+    assert "env-read-in-trace" not in _rules_hit(src)
+
+
+def test_env_read_clean_in_compat():
+    src = """
+    import os
+
+    def bridge():
+        return os.environ.get("ANYTHING_GOES")
+    """
+    assert "env-read-in-trace" not in _rules_hit(src, path="compat.py")
+
+
+# ------------------------------------------------------------ missing-donate ----
+
+def test_missing_donate_bad():
+    src = """
+    import jax
+
+    @jax.jit
+    def train_step(params, x):
+        return params - 0.1 * x
+    """
+    assert "missing-donate" in _rules_hit(src)
+
+
+def test_missing_donate_clean_donated_and_explicit_decline():
+    src = """
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def train_step(params, x):
+        return params - 0.1 * x
+
+    @partial(jax.jit, donate_argnums=())   # considered, declined
+    def oracle_step(params, x):
+        return params - 0.1 * x
+    """
+    assert "missing-donate" not in _rules_hit(src)
+
+
+# ------------------------------------------------------------- suppression ----
+
+def test_inline_allow_requires_reason():
+    bad = """
+    import os
+
+    def configure():
+        return os.environ.get("KNOB")  # graftlint: allow[env-read-in-trace]
+    """
+    assert "env-read-in-trace" in _rules_hit(bad), \
+        "a reason-less allow must NOT suppress"
+    good = """
+    import os
+
+    def configure():
+        return os.environ.get("KNOB")  # graftlint: allow[env-read-in-trace] deliberate seam because reasons
+    """
+    assert "env-read-in-trace" not in _rules_hit(good)
+
+
+def test_parse_error_is_a_finding_not_a_crash():
+    assert _rules_hit("def broken(:\n") == {"parse-error"}

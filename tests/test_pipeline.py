@@ -1,10 +1,14 @@
 """Pipeline parallelism tests (beyond-reference axis — SURVEY.md §2.5: the
 reference's only axis is DP; pp completes dp/tp/sp/pp)."""
 
+import contextlib as _contextlib
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+_noop_ctx = _contextlib.nullcontext
 
 from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
 from deeplearning4j_tpu.parallel.pipeline import (
@@ -14,6 +18,7 @@ from deeplearning4j_tpu.parallel.pipeline import (
     shard_stage_params,
     stack_stage_params,
 )
+from deeplearning4j_tpu.utils.retrace_guard import retrace_guard
 from jax.sharding import Mesh
 
 D = 16
@@ -95,11 +100,16 @@ def test_pipeline_training_reduces_loss():
     step = make_pipeline_train_step(
         _stage_fn, lambda y, t: jnp.mean((y - t) ** 2), mesh, lr=0.2)
     _, first = step(jax.tree_util.tree_map(jnp.array, params), x, tgt)
-    for _ in range(30):
-        params, loss = step(params, x, tgt)
-        # serialize dispatch: piled-up async multi-device executions can
-        # starve an XLA CPU collective rendezvous on a single-core host
-        jax.block_until_ready(loss)
+    for i in range(30):
+        # steps 0-1 may compile (first trace + committed-sharding
+        # specialization); a warmed pipeline step must never retrace
+        guard = (retrace_guard(0, label=f"pipeline step {i}") if i >= 2
+                 else _noop_ctx())
+        with guard:
+            params, loss = step(params, x, tgt)
+            # serialize dispatch: piled-up async multi-device executions can
+            # starve an XLA CPU collective rendezvous on a single-core host
+            jax.block_until_ready(loss)
     assert float(loss) < float(first) * 0.7, (float(first), float(loss))
 
 

@@ -1,0 +1,158 @@
+"""Retrace guard: compile budgets pinned for the flagship steps.
+
+The acceptance surface: a deliberately shape-unstable step FAILS the
+guard, while the composed-LM, pipeline, and DP-sync steady states each
+run under a ZERO-compile budget after warmup — shape/weak-type drift can
+never silently recompile a train step per call again."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.utils.retrace_guard import (
+    RetraceBudgetExceeded,
+    compiles_so_far,
+    retrace_guard,
+)
+
+V, D, H, E, DFF = 32, 16, 2, 2, 32
+B, T = 2, 16
+
+
+def test_counter_counts_real_compiles():
+    before = compiles_so_far()
+    f = jax.jit(lambda x: x * 3 + 1)
+    f(jnp.ones((5,)))  # at least the jitted program compiles
+    assert compiles_so_far() > before
+
+
+def test_shape_unstable_step_fails_the_guard():
+    f = jax.jit(lambda x: (x * 2).sum())
+    f(jnp.ones((3,)))  # warm one shape
+    with pytest.raises(RetraceBudgetExceeded, match="retrace budget"):
+        with retrace_guard(1, label="shape-unstable"):
+            for n in range(4, 9):  # every call a fresh shape -> recompiles
+                f(jnp.ones((n,)))
+
+
+def test_guard_does_not_mask_inner_exceptions():
+    with pytest.raises(ValueError, match="boom"):
+        with retrace_guard(0):
+            raise ValueError("boom")
+
+
+def test_weak_type_drift_is_caught():
+    """The classic silent retrace: a python scalar where an array was
+    traced gives a weak-typed tracer and a second program."""
+    f = jax.jit(lambda x, s: x * s)
+    x = jnp.ones((4,))
+    f(x, jnp.float32(2.0))  # warm the strong-typed program
+    with pytest.raises(RetraceBudgetExceeded):
+        with retrace_guard(0, label="weak-type drift"):
+            f(x, 2.0)  # python float -> weak type -> retrace
+
+
+def test_lm_composed_single_device_budget(retrace_budget):
+    from deeplearning4j_tpu.models.transformer_lm import (
+        init_lm_params,
+        make_single_device_train_step,
+    )
+
+    params = init_lm_params(jax.random.PRNGKey(0), V, D, H, E, DFF,
+                            n_layers=2)
+    step = make_single_device_train_step(H)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (B, T + 1), 0, V)
+    tk, tg = toks[:, :-1], toks[:, 1:]
+    params, loss = step(params, tk, tg)  # warmup compile
+    jax.block_until_ready(loss)
+    with retrace_budget(0, label="lm_composed steady state"):
+        for _ in range(3):
+            params, loss = step(params, tk, tg)
+        jax.block_until_ready(loss)
+    assert np.isfinite(float(loss))
+
+
+def test_lm_composed_dp_ep_budget(retrace_budget):
+    from deeplearning4j_tpu.models.transformer_lm import (
+        init_lm_params,
+        make_composed_train_step,
+        shard_lm_batch,
+        shard_lm_params,
+    )
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                ("data", "expert"))
+    params = shard_lm_params(
+        init_lm_params(jax.random.PRNGKey(0), V, D, H, E, DFF), mesh)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (B, T + 1), 0, V)
+    stoks, stgts = shard_lm_batch(toks[:, :-1], toks[:, 1:], mesh)
+    step = make_composed_train_step(mesh, H, capacity=B * T)
+    params, loss = step(params, stoks, stgts)  # warmup compile
+    jax.block_until_ready(loss)
+    with retrace_budget(0, label="dp×ep composed steady state"):
+        for _ in range(3):
+            params, loss = step(params, stoks, stgts)
+            jax.block_until_ready(loss)
+    assert np.isfinite(float(loss))
+
+
+def test_pipeline_step_budget(retrace_budget):
+    from deeplearning4j_tpu.parallel.pipeline import (
+        PIPE_AXIS,
+        make_pipeline_train_step,
+        shard_stage_params,
+        stack_stage_params,
+    )
+
+    mesh = Mesh(np.array(jax.devices()[:4]), (PIPE_AXIS,))
+    ks = jax.random.split(jax.random.PRNGKey(0), 4)
+    per_stage = [{"w": jax.random.normal(k, (D, D)) / np.sqrt(D),
+                  "b": jnp.zeros((D,))} for k in ks]
+    stage_fn = lambda p, x: jnp.tanh(x @ p["w"] + p["b"])  # noqa: E731
+    params = shard_stage_params(stack_stage_params(per_stage), mesh)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 2, D))
+    tgt = jax.random.normal(jax.random.PRNGKey(2), (4, 2, D))
+    step = make_pipeline_train_step(
+        stage_fn, lambda y, t: jnp.mean((y - t) ** 2), mesh, lr=0.1)
+    params, loss = step(params, x, tgt)  # warmup compile
+    jax.block_until_ready(loss)
+    with retrace_budget(0, label="pipeline steady state"):
+        for _ in range(3):
+            params, loss = step(params, x, tgt)
+            jax.block_until_ready(loss)
+    assert np.isfinite(float(loss))
+
+
+def test_dp_sync_step_budget(retrace_budget):
+    from deeplearning4j_tpu.models.zoo import mnist_mlp
+    from deeplearning4j_tpu.nn import functional as F
+    from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
+    from deeplearning4j_tpu.parallel.trainer import make_sync_train_step
+
+    conf = mnist_mlp(32, 16)
+    params = F.init_params(conf, jax.random.PRNGKey(0))
+    states = F.init_train_state(conf, params)
+    mesh = data_parallel_mesh(4)
+    step = make_sync_train_step(conf, mesh)
+    kx, ky = jax.random.split(jax.random.PRNGKey(1))
+    x = jax.random.uniform(kx, (16, 784), jnp.float32)
+    y = jax.nn.one_hot(jax.random.randint(ky, (16,), 0, 10), 10,
+                       dtype=jnp.float32)
+    w = jnp.ones((16,), jnp.float32)
+    key = jax.random.PRNGKey(2)
+    # TWO warmup calls: the first traces against the host-placed inputs,
+    # the second compiles once more against the committed output shardings
+    # the sharded step produces. From there the program is pinned stable.
+    for i in range(2):
+        params, states, score = step(params, states, jnp.asarray(i), x, y, w,
+                                     key)
+    jax.block_until_ready(score)
+    with retrace_budget(0, label="DP-sync steady state"):
+        for i in range(2, 5):
+            # graftlint-style discipline: same dtypes/shapes every call
+            params, states, score = step(params, states, jnp.asarray(i), x,
+                                         y, w, key)
+            jax.block_until_ready(score)
+    assert np.isfinite(float(score))

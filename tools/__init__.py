@@ -1,0 +1,5 @@
+"""Repo tooling (lint gate, benchmarks helpers, reports).
+
+A real package so ``[project.scripts]`` entries (graftlint) can resolve
+``tools.lint_gate:main`` from an installed wheel as well as a checkout.
+"""

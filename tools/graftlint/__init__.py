@@ -1,0 +1,33 @@
+"""graftlint — JAX-aware static analysis for the jax_graft tree.
+
+The reference DL4J leaned on the JVM type system for its correctness
+story; this rebuild's recurring failure classes are *performance*
+semantics the Python type system cannot see: hidden host↔device syncs
+inside jitted code, per-step device fetches that serialize dispatch,
+benchmark timers stopped at enqueue instead of completion, PRNG keys
+consumed twice, nondeterministic pytree structure from set iteration,
+un-blessed environment seams, and train steps that never declare a
+donation decision. graftlint encodes each as an AST rule.
+
+Public surface::
+
+    from tools.graftlint import lint_source, lint_paths, Finding, RULES
+    from tools.graftlint.baseline import load_baseline, apply_baseline
+
+``tools/lint_gate.py`` is the CLI / CI gate; tests/test_graftlint_repo.py
+runs the same gate as a tier-1 test with the checked-in baseline.
+"""
+
+from tools.graftlint.baseline import (  # noqa: F401
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+from tools.graftlint.engine import (  # noqa: F401
+    Finding,
+    RULES,
+    lint_file,
+    lint_paths,
+    lint_source,
+)
+from tools.graftlint import rules as _rules  # noqa: F401  (registers RULES)

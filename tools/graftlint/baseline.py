@@ -1,0 +1,85 @@
+"""Checked-in baseline/allowlist for graftlint.
+
+Format (tools/graftlint_baseline.json)::
+
+    {"version": 1,
+     "entries": [
+       {"rule": "env-read-in-trace",
+        "path": "deeplearning4j_tpu/parallel/multihost.py",
+        "snippet": "coordinator = os.environ.get(",
+        "why": "distributed bootstrap seam; host-side at process init"}]}
+
+Matching is by ``(rule, path)`` plus ``snippet`` being a *substring* of
+the finding's normalized source line — stable across line-number churn
+and surrounding edits. Every entry MUST carry a non-empty ``why``;
+``--update-baseline`` seeds new entries with a FIXME why that the
+tier-1 gate refuses, so an unjustified allowlist can't land.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, List, Tuple
+
+from tools.graftlint.engine import Finding
+
+FIXME_WHY = "FIXME: justify this entry or fix the finding"
+
+
+def load_baseline(path: str) -> List[Dict]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return []
+    entries = data.get("entries", [])
+    for e in entries:
+        for field in ("rule", "path", "snippet", "why"):
+            if not str(e.get(field, "")).strip():
+                raise ValueError(
+                    f"baseline entry missing required '{field}': {e!r}")
+    return entries
+
+
+def _matches(entry: Dict, finding: Finding) -> bool:
+    return (entry["rule"] == finding.rule
+            and entry["path"] == finding.path
+            and entry["snippet"] in finding.snippet)
+
+
+def apply_baseline(findings: Iterable[Finding], entries: List[Dict],
+                   ) -> Tuple[List[Finding], List[Dict], List[Dict]]:
+    """(non-baselined findings, used entries, stale entries). A stale
+    entry matched nothing — the underlying code was fixed or moved; prune
+    it (``--update-baseline``) so the allowlist can only shrink honestly."""
+    fresh: List[Finding] = []
+    used: List[Dict] = []
+    for f in findings:
+        hit = next((e for e in entries if _matches(e, f)), None)
+        if hit is None:
+            fresh.append(f)
+        elif hit not in used:
+            used.append(hit)
+    stale = [e for e in entries if not any(_matches(e, f) for f in findings)]
+    return fresh, used, stale
+
+
+def write_baseline(path: str, findings: Iterable[Finding],
+                   old_entries: List[Dict]) -> List[Dict]:
+    """Regenerate the baseline from current findings, carrying forward the
+    why of any old entry that still matches; new entries get FIXME whys."""
+    entries: List[Dict] = []
+    for f in findings:
+        old = next((e for e in old_entries if _matches(e, f)), None)
+        entry = {
+            "rule": f.rule,
+            "path": f.path,
+            "snippet": f.snippet,
+            "why": old["why"] if old else FIXME_WHY,
+        }
+        if entry not in entries:
+            entries.append(entry)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "entries": entries}, fh, indent=1)
+        fh.write("\n")
+    return entries

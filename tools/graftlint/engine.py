@@ -1,0 +1,290 @@
+"""graftlint core: findings, rule registry, and the per-module context.
+
+Zero dependencies beyond the stdlib ``ast`` module. Each rule is a
+function ``rule(ctx) -> Iterable[Finding]`` registered under a stable
+rule id; ``ModuleContext`` does the shared work every JAX-aware rule
+needs — which functions are *traced* (reachable inside ``jax.jit`` /
+``shard_map`` / ``lax.scan`` bodies), which module names dispatch
+compiled programs when called, and in-file constant resolution.
+
+Findings are keyed for the baseline by ``(rule, path, snippet)`` where
+``snippet`` is the whitespace-normalized source line — stable across
+unrelated edits that only move code, unlike line numbers.
+
+Inline suppression (colocated allowlist, reason REQUIRED)::
+
+    cost = float(cost)  # graftlint: allow[jit-host-sync] convergence check needs the host value
+
+A suppression comment without a reason does not suppress.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Callable, Dict, Iterable, List, Optional
+
+# --------------------------------------------------------------- findings ----
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    message: str
+    hint: str
+    snippet: str  # whitespace-normalized source line (baseline key)
+
+    def key(self):
+        return (self.rule, self.path, self.snippet)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.rule}] {self.message}\n"
+                f"    {self.snippet}\n    hint: {self.hint}")
+
+
+# ---------------------------------------------------------------- registry ----
+
+RULES: Dict[str, Callable] = {}
+
+
+def register(rule_id: str):
+    def deco(fn):
+        RULES[rule_id] = fn
+        fn.rule_id = rule_id
+        return fn
+    return deco
+
+
+# ------------------------------------------------------------- module ctx ----
+
+# callables whose function-valued arguments are traced with abstract values
+TRACE_WRAPPERS = {
+    "jit", "pmap", "vmap", "grad", "value_and_grad", "checkpoint", "remat",
+    "shard_map", "scan", "while_loop", "fori_loop", "cond", "switch",
+    "associative_scan", "custom_vjp", "custom_jvp", "named_call",
+}
+# decorators that make the decorated body traced
+TRACE_DECORATORS = {"jit", "pmap", "vmap", "checkpoint", "remat",
+                    "custom_vjp", "custom_jvp", "shard_map"}
+
+_FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def dotted(node: ast.AST) -> str:
+    """'jax.random.split' for the Attribute chain, '' when not a chain."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    return ""
+
+
+def last_part(node: ast.AST) -> str:
+    return dotted(node).rsplit(".", 1)[-1]
+
+
+class ModuleContext:
+    """Parsed module + the shared analyses rules build on."""
+
+    def __init__(self, src: str, path: str):
+        self.path = path
+        self.src_lines = src.splitlines()
+        self.tree = ast.parse(src, filename=path)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        self.functions: List[ast.AST] = []
+        self.defs_by_name: Dict[str, List[ast.AST]] = {}
+        self.module_str_constants: Dict[str, str] = {}
+        self._index()
+        self.traced: set = set()
+        self.jitted_names: set = set()
+        self._find_traced()
+
+    # ---- indexing ----
+    def _index(self) -> None:
+        stack = [self.tree]
+        while stack:
+            node = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+                stack.append(child)
+                if isinstance(child, _FuncNode):
+                    self.functions.append(child)
+                    name = getattr(child, "name", None)
+                    if name:
+                        self.defs_by_name.setdefault(name, []).append(child)
+        for stmt in self.tree.body:
+            if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Constant)
+                    and isinstance(stmt.value.value, str)):
+                self.module_str_constants[stmt.targets[0].id] = stmt.value.value
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None and not isinstance(cur, _FuncNode):
+            cur = self.parents.get(cur)
+        return cur
+
+    def src_line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.src_lines):
+            return self.src_lines[lineno - 1]
+        return ""
+
+    def snippet(self, lineno: int) -> str:
+        return " ".join(self.src_line(lineno).split())
+
+    def resolve_str(self, node: ast.AST) -> Optional[str]:
+        """A string literal, or an in-file module-level str constant name."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.Name):
+            return self.module_str_constants.get(node.id)
+        return None
+
+    # ---- traced-function analysis ----
+    def _decorator_traces(self, deco: ast.AST) -> bool:
+        """True when any name inside the decorator expression is a tracer
+        wrapper — covers @jax.jit, @jit, @partial(jax.jit, ...)."""
+        return any(last_part(n) in TRACE_DECORATORS
+                   for n in ast.walk(deco)
+                   if isinstance(n, (ast.Name, ast.Attribute)))
+
+    def _decorator_jits(self, deco: ast.AST) -> bool:
+        return any(last_part(n) == "jit" for n in ast.walk(deco)
+                   if isinstance(n, (ast.Name, ast.Attribute)))
+
+    def _find_traced(self) -> None:
+        # seed 1: decorated defs
+        for fn in self.functions:
+            for deco in getattr(fn, "decorator_list", []):
+                if self._decorator_traces(deco):
+                    self.traced.add(fn)
+                if self._decorator_jits(deco) and getattr(fn, "name", None):
+                    self.jitted_names.add(fn.name)
+        # seed 2: functions passed to tracer wrappers; names bound to jit(...)
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                name = last_part(node.func)
+                if name == "map" and not dotted(node.func).endswith("lax.map"):
+                    continue
+                if name in TRACE_WRAPPERS or (
+                        name == "map" and dotted(node.func).endswith("lax.map")):
+                    for arg in list(node.args) + [kw.value for kw in
+                                                  node.keywords]:
+                        if isinstance(arg, ast.Lambda):
+                            self.traced.add(arg)
+                        elif isinstance(arg, ast.Name):
+                            for d in self.defs_by_name.get(arg.id, []):
+                                self.traced.add(d)
+            if (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)
+                    and last_part(node.value.func) == "jit"):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.jitted_names.add(tgt.id)
+        # propagate: nested defs inside traced fns + local callees of traced fns
+        for _ in range(10):
+            before = len(self.traced)
+            for fn in list(self.traced):
+                for node in ast.walk(fn):
+                    if node is fn:
+                        continue
+                    if isinstance(node, _FuncNode):
+                        self.traced.add(node)
+                    if isinstance(node, ast.Call) and isinstance(node.func,
+                                                                 ast.Name):
+                        for d in self.defs_by_name.get(node.func.id, []):
+                            self.traced.add(d)
+            if len(self.traced) == before:
+                break
+
+    def walk_in_function(self, fn: ast.AST, node_type) -> Iterable[ast.AST]:
+        """Nodes of ``node_type`` whose *directly* enclosing function is
+        ``fn`` (nested function bodies are excluded — they run on their own
+        schedule, not in ``fn``'s)."""
+        for node in ast.walk(fn):
+            if isinstance(node, node_type) and (
+                    self.enclosing_function(node) is fn):
+                yield node
+
+
+# ------------------------------------------------------------ suppression ----
+
+_ALLOW_RE = re.compile(
+    r"#\s*graftlint:\s*allow\[([a-z0-9\-, ]+)\]\s+(\S.*)")
+
+
+def _suppressed(ctx: ModuleContext, finding: Finding) -> bool:
+    """Inline allow on the finding's line or the line above, reason
+    required (a bare tag without a why does not suppress)."""
+    for lineno in (finding.line, finding.line - 1):
+        m = _ALLOW_RE.search(ctx.src_line(lineno))
+        if m and finding.rule in [r.strip() for r in m.group(1).split(",")]:
+            return True
+    return False
+
+
+# ------------------------------------------------------------ entrypoints ----
+
+def lint_source(src: str, path: str,
+                rule_ids: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Lint one module's source; returns findings after inline suppression.
+    Files that do not parse yield a single ``parse-error`` finding (a
+    linter must never crash the gate on bad input)."""
+    try:
+        ctx = ModuleContext(src, path)
+    except SyntaxError as e:
+        return [Finding("parse-error", path, e.lineno or 1,
+                        f"file does not parse: {e.msg}",
+                        "fix the syntax error", "")]
+    out: List[Finding] = []
+    for rid, rule in RULES.items():
+        if rule_ids is not None and rid not in rule_ids:
+            continue
+        out.extend(rule(ctx))
+    out = [f for f in out if not _suppressed(ctx, f)]
+    seen: set = set()
+    deduped = []
+    for f in out:  # nested scans (e.g. loop-in-loop) can re-derive a finding
+        k = (f.rule, f.path, f.line, f.message)
+        if k not in seen:
+            seen.add(k)
+            deduped.append(f)
+    deduped.sort(key=lambda f: (f.path, f.line, f.rule))
+    return deduped
+
+
+def lint_file(path: str, rel_path: Optional[str] = None) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    return lint_source(src, rel_path or path)
+
+
+def lint_paths(paths: Iterable[str], root: str) -> List[Finding]:
+    """Lint every ``.py`` under each path (file or directory), reporting
+    repo-relative posix paths."""
+    import os
+
+    files: List[str] = []
+    for p in paths:
+        ap = os.path.join(root, p) if not os.path.isabs(p) else p
+        if os.path.isdir(ap):
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in ("__pycache__", ".git"))
+                files.extend(os.path.join(dirpath, fn)
+                             for fn in sorted(filenames)
+                             if fn.endswith(".py"))
+        elif ap.endswith(".py") and os.path.exists(ap):
+            files.append(ap)
+    out: List[Finding] = []
+    for fp in files:
+        rel = os.path.relpath(fp, root).replace(os.sep, "/")
+        out.extend(lint_file(fp, rel))
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out

@@ -1,0 +1,128 @@
+"""graftlint CI/tier-1 gate.
+
+Run standalone::
+
+    python tools/lint_gate.py                 # human output, exit 1 on findings
+    python tools/lint_gate.py --json          # machine output
+    python tools/lint_gate.py --update-baseline   # regenerate the allowlist
+    python tools/lint_gate.py deeplearning4j_tpu/models/word2vec.py
+
+or as the installed ``graftlint`` console script ([project.scripts]).
+tests/test_graftlint_repo.py calls :func:`run_gate` directly, so the
+tier-1 suite and this CLI can never disagree about what "clean" means.
+
+Baseline workflow: a deliberate exception gets an entry in
+``tools/graftlint_baseline.json`` with a one-line ``why`` (or an inline
+``# graftlint: allow[rule] why`` on the offending line). A fixed finding
+leaves a *stale* entry behind; the gate fails on stale entries until
+``--update-baseline`` prunes them, so the allowlist only ever shrinks
+by being honest about it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+if __package__ in (None, ""):  # executed as a script: repo root onto path
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.graftlint import (  # noqa: E402
+    apply_baseline,
+    lint_paths,
+    load_baseline,
+    write_baseline,
+)
+from tools.graftlint.baseline import FIXME_WHY  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE_PATH = os.path.join(REPO_ROOT, "tools", "graftlint_baseline.json")
+
+# the repo gate's scan set: the package, the tooling, and the bench
+# drivers. tests/ is exercised through golden fixtures instead — test code
+# legitimately does host-sync things the rules exist to forbid elsewhere.
+DEFAULT_TARGETS = (
+    "deeplearning4j_tpu",
+    "tools",
+    "bench.py",
+    "scaling_bench.py",
+    "accuracy_gates.py",
+)
+
+
+def run_gate(paths=None, baseline_path: str = BASELINE_PATH,
+             use_baseline: bool = True):
+    """(non-baselined findings, stale baseline entries, all findings)."""
+    findings = lint_paths(paths or DEFAULT_TARGETS, REPO_ROOT)
+    if not use_baseline:
+        return findings, [], findings
+    entries = load_baseline(baseline_path)
+    fresh, used, stale = apply_baseline(findings, entries)
+    fixme = [e for e in used if e["why"].startswith("FIXME")]
+    if fixme:  # an unjustified allowlist entry is itself a finding
+        from tools.graftlint.engine import Finding
+
+        fresh = list(fresh) + [
+            Finding("unjustified-baseline", e["path"], 0,
+                    f"baseline entry for [{e['rule']}] has no real why",
+                    "edit tools/graftlint_baseline.json: replace the FIXME "
+                    "with a one-line justification", e["snippet"])
+            for e in fixme]
+    return fresh, stale, findings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description="JAX-aware static analysis gate (see tools/graftlint/)")
+    ap.add_argument("paths", nargs="*",
+                    help=f"files/dirs to lint (default: {DEFAULT_TARGETS})")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as a JSON object")
+    ap.add_argument("--baseline", default=BASELINE_PATH,
+                    help="baseline/allowlist path")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report raw findings, ignoring the baseline")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="regenerate the baseline from current findings "
+                         "(carries forward existing whys; new entries get "
+                         f"'{FIXME_WHY}')")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or None
+    if args.update_baseline:
+        findings = lint_paths(paths or DEFAULT_TARGETS, REPO_ROOT)
+        old = load_baseline(args.baseline)
+        entries = write_baseline(args.baseline, findings, old)
+        n_fixme = sum(1 for e in entries if e["why"].startswith("FIXME"))
+        print(f"baseline: {len(entries)} entries written to {args.baseline}"
+              + (f" ({n_fixme} need a why — gate fails until justified)"
+                 if n_fixme else ""))
+        return 0
+
+    fresh, stale, all_findings = run_gate(
+        paths, args.baseline, use_baseline=not args.no_baseline)
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in fresh],
+            "stale_baseline_entries": stale,
+            "total_findings_including_baselined": len(all_findings),
+        }, indent=1))
+    else:
+        for f in fresh:
+            print(f.render())
+        for e in stale:
+            print(f"STALE baseline entry (code was fixed — run "
+                  f"--update-baseline to prune): [{e['rule']}] {e['path']}: "
+                  f"{e['snippet']}")
+        n_base = len(all_findings) - len(
+            [f for f in fresh if f.rule != "unjustified-baseline"])
+        print(f"graftlint: {len(fresh)} finding(s), {n_base} baselined, "
+              f"{len(stale)} stale baseline entr(ies)")
+    return 1 if (fresh or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
