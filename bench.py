@@ -551,6 +551,88 @@ def mfu(model: str, samples_per_sec: float, precision: str) -> float:
             / PRECISION_PEAKS.get(precision, PEAK_BF16_FLOPS))
 
 
+def measure_ckpt() -> float:
+    """Sharded checkpoint save/restore wall time and bytes for the
+    composed-LM params at dp×ep (scaleout/ckpt): warm save + restore,
+    median of 3 each, through the real Checkpointer (manifest commit,
+    retention, telemetry counters included — this is the path a training
+    run pays). Returns save MB/s; restore timing, bytes, and chunk count
+    land in the stage detail."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.models.transformer_lm import (
+        init_lm_params,
+        lm_param_shardings,
+        shard_lm_params,
+    )
+    from deeplearning4j_tpu.scaleout.ckpt import Checkpointer
+    from deeplearning4j_tpu.scaleout.ckpt.manifest import read_manifest
+    from jax.sharding import Mesh
+
+    if _fast():
+        vocab, d, heads, experts, dff, layers = 256, 64, 2, 2, 128, 2
+    else:
+        vocab, d, heads, experts, dff, layers = (
+            LMC_VOCAB, LMC_D, LMC_HEADS, LMC_EXPERTS, LMC_DFF, LMC_LAYERS)
+
+    devs = jax.devices()
+    ep = experts if (len(devs) >= experts
+                     and len(devs) % experts == 0) else 1
+    dp = max(len(devs) // ep, 1)
+    mesh = Mesh(np.array(devs[: dp * ep]).reshape(dp, ep),
+                ("data", "expert"))
+
+    params = init_lm_params(jax.random.PRNGKey(0), vocab, d, heads, experts,
+                            dff, n_layers=layers)
+    sharded = shard_lm_params(params, mesh)
+    state = {"params": sharded}
+    jax.block_until_ready(sharded)  # nothing enqueued before the clocks
+
+    root = tempfile.mkdtemp(prefix="ckpt_bench_")
+    ck = Checkpointer(root, keep_last=2)
+    ck.save(0, state, mesh=mesh)  # warmup: dir creation, allocator, caches
+
+    def one_save(step):
+        t0 = time.perf_counter()
+        step_dir = ck.save(step, state, mesh=mesh)
+        # graftlint: allow[untimed-dispatch] ck.save fetches every shard via np.asarray and fsyncs the files — host-synchronous IO, nothing enqueued
+        return time.perf_counter() - t0, step_dir
+
+    saves = [one_save(i + 1) for i in range(3)]
+    save_s = statistics.median(t for t, _ in saves)
+    step_dir = saves[-1][1]
+    manifest = read_manifest(step_dir)
+    n_bytes = manifest.total_bytes
+    n_chunks = sum(len(e.chunks) for e in manifest.leaves)
+
+    template = {"params": params}
+    shardings = {"params": lm_param_shardings(params, mesh)}
+
+    def one_restore():
+        t0 = time.perf_counter()
+        restored, _step, _meta = ck.restore(template, shardings)
+        jax.block_until_ready(restored)  # fence the device placement
+        return time.perf_counter() - t0
+
+    restore_s = statistics.median(one_restore() for _ in range(3))
+    mb = n_bytes / 1e6
+    detail = {
+        "save_ms": round(save_s * 1e3, 2),
+        "restore_ms": round(restore_s * 1e3, 2),
+        "mb": round(mb, 2),
+        "chunks": n_chunks,
+        "shard_files": len(manifest.files),
+        "mesh": {"data": dp, "expert": ep},
+        "save_mb_per_sec": round(mb / save_s, 1),
+        "restore_mb_per_sec": round(mb / restore_s, 1),
+    }
+    print("STAGE_DETAIL " + json.dumps(detail), flush=True)
+    return mb / save_s
+
+
 # ---------------------------------------------------------------------------
 # Stage orchestration. Each stage is `python bench.py --stage NAME`, run by
 # main() in a subprocess with a timeout, so a wedged XLA compile is contained.
@@ -633,6 +715,8 @@ def run_stage(name: str) -> float:
             "dense" if name.endswith("_densecore") else "blockwise")
         return measure_lm_composed(
             telemetry=not name.endswith("_densecore"))
+    if name == "ckpt":
+        return measure_ckpt()
     if name == "word2vec":
         if _fast():
             return measure_word2vec(n_sentences=100, sent_len=20, vocab=200)
@@ -713,6 +797,7 @@ STAGES = [
     ("cpu_lm_composed", 280),
     ("lm_composed", 280),
     ("lm_composed_densecore", 240),
+    ("ckpt", 150),
     ("cpu_word2vec", 150),
     ("word2vec", 120),
     ("cpu_word2vec_large", 300),
@@ -778,6 +863,8 @@ def main() -> None:
             continue
         if "word2vec" in stage:
             key = f"{stage}_words_per_sec"
+        elif stage == "ckpt":
+            key = f"{stage}_save_mb_per_sec"
         else:
             key = f"{stage}_samples_per_sec"
         remaining = deadline - time.monotonic()
@@ -831,6 +918,12 @@ def main() -> None:
         "cpu_lm_composed is the same blockwise stage in a forced-CPU "
         "child (batch=1). MFU is vs the fp32-DEFAULT peak; dense_moe "
         "executes all E experts per token and the FLOP model counts that."
+    )
+    detail["ckpt_note"] = (
+        "ckpt = sharded save/restore (scaleout/ckpt) of the composed-LM "
+        "params at dp×ep through the real Checkpointer (per-shard npz + "
+        "atomic manifest + retention); value is save MB/s, detail carries "
+        "restore MB/s, bytes, and chunk/file counts."
     )
     detail["attn_note"] = (
         "attn_bf16 (T=64, d=256) is the r04-continuity stage and is "

@@ -285,22 +285,30 @@ def composed_loss_fn(mesh: Mesh, n_heads: int, capacity: int,
                    moe_fn=moe_fn, aux_weight=aux_weight)
 
 
-def shard_lm_params(params: dict, mesh: Mesh) -> dict:
-    """Experts onto the expert axis (when present), everything else
+def lm_param_shardings(params: dict, mesh: Mesh) -> dict:
+    """Per-leaf NamedSharding pytree for the flagship params on ``mesh``:
+    experts onto the expert axis (when present), everything else
     replicated. Block leaves carry a leading layer axis, so the expert dim
-    is axis 1 there."""
+    is axis 1 there. This is the placement map BOTH ``shard_lm_params``
+    (initial placement) and the checkpoint resharding loader
+    (``scaleout.ckpt.restore_sharded``) use, so a restore onto any mesh
+    lands exactly where a fresh init would."""
     names = mesh.axis_names
     rep = NamedSharding(mesh, P())
-    out = {k: jax.device_put(v, rep) for k, v in params.items()
-           if k != "blocks"}
-    blocks = {k: jax.device_put(v, rep) for k, v in params["blocks"].items()
-              if k != "experts"}
+    out = {k: rep for k in params if k != "blocks"}
+    blocks = {k: rep for k in params["blocks"] if k != "experts"}
     espec = P(None, EXPERT_AXIS) if EXPERT_AXIS in names else P()
+    esharding = NamedSharding(mesh, espec)
     blocks["experts"] = jax.tree_util.tree_map(
-        lambda a: jax.device_put(a, NamedSharding(mesh, espec)),
-        params["blocks"]["experts"])
+        lambda _: esharding, params["blocks"]["experts"])
     out["blocks"] = blocks
     return out
+
+
+def shard_lm_params(params: dict, mesh: Mesh) -> dict:
+    """Place the params per ``lm_param_shardings``."""
+    return jax.tree_util.tree_map(jax.device_put, params,
+                                  lm_param_shardings(params, mesh))
 
 
 def shard_lm_batch(tokens: Array, targets: Array, mesh: Mesh) -> tuple:
@@ -466,3 +474,39 @@ def make_pp_loss(stage_fn, mesh: Mesh, pipe_axis: str,
         return jnp.mean(nll)
 
     return loss
+
+
+def pp_trained_to_lm_params(trained) -> dict:
+    """The dp×pp training carry — (stacked stage params, embed, dec_w,
+    dec_b) — back to the CANONICAL params dict ``init_lm_params`` produces:
+    stage axis (S, L/S, ...) merged to the (L, ...) block axis.
+
+    This is the checkpoint boundary for pipeline runs: snapshots persist
+    the canonical layout, so a dp×pp save restores onto dp×sp×ep, dp×ep,
+    or a single device without knowing it was ever staged (the resharding
+    matrix in README "Checkpointing")."""
+    from deeplearning4j_tpu.parallel.pipeline import merge_stage_axis
+
+    stacked, embed, dec_w, dec_b = trained
+    return {"embed": embed, "blocks": merge_stage_axis(stacked),
+            "dec_w": dec_w, "dec_b": dec_b}
+
+
+def lm_params_to_pp_trained(params: dict, mesh: Mesh, n_heads: int,
+                            n_stages: int, pipe_axis: str = "pipe",
+                            top_k: int = 2,
+                            attn_impl: Optional[str] = None):
+    """Canonical params → the dp×pp carry: (trained tuple, stage_fn). The
+    resume path of a pipeline run — restore the canonical dict (any
+    save-time mesh), then re-stage it onto the current pipe axis."""
+    from deeplearning4j_tpu.parallel.pipeline import (
+        shard_stage_params,
+        stack_stage_params,
+    )
+
+    per_stage, stage_fn = make_pp_stages(params, n_heads, n_stages=n_stages,
+                                         top_k=top_k, attn_impl=attn_impl)
+    stacked = shard_stage_params(stack_stage_params(per_stage), mesh,
+                                 pipe_axis)
+    trained = (stacked, params["embed"], params["dec_w"], params["dec_b"])
+    return trained, stage_fn

@@ -159,6 +159,11 @@ class MultiLayerNetwork:
                     dispatch_listeners,
                 )
 
+                # refresh the facade BEFORE dispatch (reference assignment,
+                # no host sync) so state-capturing listeners — the ckpt
+                # subsystem's CheckpointIterationListener — snapshot the
+                # current iteration's params/updater state
+                self._params, self._train_state = params, state
                 dispatch_listeners(self.listeners, self, self._iteration,
                                    float(score))
         self._params, self._train_state = params, state
@@ -187,6 +192,8 @@ class MultiLayerNetwork:
                     )
                     self._iteration += 1
                     if self.listeners:
+                        # fresh refs before dispatch: see _do_backward
+                        self._params, self._train_state = params, state
                         dispatch_listeners(self.listeners, self,
                                            self._iteration, float(score))
         finally:

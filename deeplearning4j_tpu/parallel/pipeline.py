@@ -134,6 +134,25 @@ def shard_stage_params(stacked, mesh: Mesh, axis: str = PIPE_AXIS):
     return shard_leading_axis(stacked, mesh, axis)
 
 
+def unstack_stage_params(stacked) -> list:
+    """{k: (S, ...) array} → [{k: array}, ...] — inverse of
+    stack_stage_params (per-stage views for inspection/re-staging)."""
+    n_stages = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+    return [jax.tree_util.tree_map(lambda a: a[i], stacked)
+            for i in range(n_stages)]
+
+
+def merge_stage_axis(stacked):
+    """(S, per, ...) stage-stacked leaves → (S·per, ...) — stage i's local
+    slice becomes layers [i·per, (i+1)·per) of the contiguous stack. The
+    canonicalization step checkpoints of pipeline runs go through (see
+    models/transformer_lm.pp_trained_to_lm_params): the persisted layout
+    is mesh-independent, so a dp×pp snapshot restores onto any mesh."""
+    return jax.tree_util.tree_map(
+        lambda a: a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:]),
+        stacked)
+
+
 def pipeline_from_conf(conf, params, mesh: Mesh, layers=None,
                        axis: str = PIPE_AXIS):
     """Stage a uniform DENSE segment of a MultiLayerConfiguration onto the

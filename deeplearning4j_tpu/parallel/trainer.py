@@ -216,6 +216,8 @@ class ParameterAveragingTrainer:
         mesh: Optional[Mesh] = None,
         average_each_iteration: bool = False,
         local_iterations: Optional[int] = None,
+        checkpointer=None,
+        checkpoint_every: int = 0,
     ):
         from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
 
@@ -230,6 +232,39 @@ class ParameterAveragingTrainer:
         self._sync_step = None
         self._fit_step = None
         self._iteration = 0
+        # periodic sharded checkpoints (scaleout.ckpt) through the same
+        # exception-safe listener dispatch as every other listener: a save
+        # failure is logged and skipped, never killing the fit
+        self._ckpt_listener = None
+        if checkpointer is not None and checkpoint_every > 0:
+            from deeplearning4j_tpu.scaleout.ckpt import (
+                CheckpointIterationListener,
+            )
+
+            self._ckpt_listener = CheckpointIterationListener(
+                checkpointer, save_every=checkpoint_every, mesh=self.mesh)
+
+    def resume(self, checkpointer) -> Optional[int]:
+        """Restore net params/updater state/RNG/iteration from the latest
+        committed checkpoint under ``checkpointer`` (replicated onto this
+        trainer's mesh) and continue counting from its step. Returns the
+        resumed step, or None when no checkpoint exists yet."""
+        from deeplearning4j_tpu.scaleout.ckpt import (
+            capture_net_state,
+            replicated_shardings,
+            restore_net_state,
+        )
+
+        if checkpointer.latest_step() is None:
+            return None
+        net = self.net
+        net._ensure_train_step()
+        template, _meta = capture_net_state(net)
+        state, step, meta = checkpointer.restore(
+            template, shardings=replicated_shardings(template, self.mesh))
+        restore_net_state(net, state, meta)
+        self._iteration = int(meta.get("iteration", step))
+        return step
 
     @property
     def n_devices(self) -> int:
@@ -280,6 +315,19 @@ class ParameterAveragingTrainer:
             dispatch_listeners,
         )
 
+        listeners = list(net.listeners)
+        if self._ckpt_listener is not None:
+            listeners.append(self._ckpt_listener)
+
+        def publish(params, states):
+            # reference-only refresh (no host sync): listeners — notably the
+            # checkpoint listener's capture_net_state — must snapshot the
+            # CURRENT training state, not the pre-fit buffers. The next
+            # step() call donates these arrays, but dispatch runs before it.
+            net._params = params
+            net._train_state = states
+            net._iteration = self._iteration
+
         try:
             if self.average_each_iteration:
                 if self._sync_step is None:
@@ -292,7 +340,8 @@ class ParameterAveragingTrainer:
                         net._keys.next(),
                     )
                     self._iteration += 1
-                    dispatch_listeners(net.listeners, net, self._iteration,
+                    publish(params, states)
+                    dispatch_listeners(listeners, net, self._iteration,
                                        float(score))
             else:
                 if self._fit_step is None:
@@ -307,12 +356,13 @@ class ParameterAveragingTrainer:
                         net._keys.next(),
                     )
                     self._iteration += self.local_iterations
-                    dispatch_listeners(net.listeners, net, self._iteration,
+                    publish(params, states)
+                    dispatch_listeners(listeners, net, self._iteration,
                                        float(score))
         finally:
             # a crash mid-fit must not leave e.g. a ProfilerIterationListener
             # with an open trace window armed
-            close_listeners(net.listeners)
+            close_listeners(listeners)
 
         net._params = jax.tree_util.tree_map(lambda a: a, params)
         net._train_state = states

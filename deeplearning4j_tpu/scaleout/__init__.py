@@ -18,3 +18,7 @@ from deeplearning4j_tpu.scaleout.runner import (  # noqa: F401
     EarlyStopping,
     LocalDistributedRunner,
 )
+from deeplearning4j_tpu.scaleout.ckpt import (  # noqa: F401
+    Checkpointer,
+    CheckpointIterationListener,
+)

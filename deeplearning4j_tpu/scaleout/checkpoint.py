@@ -1,26 +1,39 @@
-"""Full-state training checkpoints.
+"""Single-file training checkpoints — thin wrapper over ``scaleout/ckpt``.
 
 The reference checkpoints only conf JSON + flat params (ModelSaver /
 MultiLayerNetwork(String conf, INDArray params); SURVEY.md §5: "No
-optimizer-state or mid-epoch resume"). This build goes further: a checkpoint
-captures the complete training state — per-layer params, per-layer updater
-state (AdaGrad accumulators, momentum velocities), and the iteration counter
-— so training resumes bit-exactly where it stopped.
+optimizer-state or mid-epoch resume"). This build captures the complete
+training state — per-layer params, per-layer updater state, the host RNG
+stream position, and the iteration counter — so training resumes
+bit-exactly where it stopped.
 
-Format: one .npz with flattened tree paths as keys plus the conf JSON;
-no framework-specific dependency (orbax would add async/multi-host machinery
-this single-controller runtime doesn't need yet).
+WHAT "training state" means lives in ``scaleout/ckpt/net_state.py``
+(shared with the sharded subsystem's ``CheckpointIterationListener``);
+this module only chooses the container: one self-contained ``.npz`` — the
+right shape for single-device nets, blob stores, and byte-oriented
+transports. Sharded/composed-mesh runs should use ``scaleout.ckpt``
+directly (per-shard files + manifest, mesh-independent resume).
+
+Strictness matches the sharded loader: a shape mismatch or a lossy dtype
+narrowing at load time raises instead of silently broadcasting or
+``astype``-truncating into the template. The tmp file is unique per
+writer (pid + uuid), so concurrent savers to the same path can never
+clobber each other's partial writes, and a failed save cleans its tmp up
+— the previous checkpoint at ``path`` survives any crash mid-save.
 """
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Any, Dict, Optional, Tuple
+import uuid
+from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
 
 _CONF_KEY = "__conf_json__"
+_META_KEY = "__meta_json__"
 _ITER_KEY = "__iteration__"
 _RNG_KEY = "__rng_key__"
 _RNG_IMPL_KEY = "__rng_impl__"
@@ -37,47 +50,60 @@ def _flatten_with_paths(tree) -> Dict[str, np.ndarray]:
 
 
 def save_checkpoint(path: str, net, iteration: Optional[int] = None) -> str:
-    """Write params + updater state + iteration + conf. Returns the path."""
+    """Write params + updater state + iteration + RNG + conf. Returns the
+    path. Atomic: payload goes to a unique tmp file first (pid+uuid — two
+    concurrent savers cannot collide), then ``os.replace`` commits; on any
+    failure the tmp is removed and the existing checkpoint is untouched."""
+    from deeplearning4j_tpu.scaleout.ckpt.net_state import capture_net_state
+
     path = path if path.endswith(".npz") else path + ".npz"
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
+
+    tree, meta = capture_net_state(net, iteration=iteration)
     payload: Dict[str, Any] = {}
-    for k, v in _flatten_with_paths({"params": net.params_tree}).items():
+    for k, v in _flatten_with_paths({"params": tree["params"]}).items():
         payload[k] = v
-    state = getattr(net, "_train_state", None)
-    if state is not None:
-        for k, v in _flatten_with_paths({"state": state}).items():
+    if "state" in tree:
+        for k, v in _flatten_with_paths({"state": tree["state"]}).items():
             payload[k] = v
-    payload[_CONF_KEY] = np.frombuffer(
-        net.conf.to_json().encode(), dtype=np.uint8
-    )
-    it = iteration if iteration is not None else getattr(net, "_iteration", 0)
-    payload[_ITER_KEY] = np.asarray(it, np.int64)
-    keys = getattr(net, "_keys", None)
-    if keys is not None:
-        # persist the host RNG stream position so stochastic confs (dropout,
-        # drop-connect, AE corruption) also resume exactly
-        if jax.dtypes.issubdtype(keys._key.dtype, jax.dtypes.prng_key):
-            payload[_RNG_KEY] = np.asarray(jax.random.key_data(keys._key))
+    payload[_CONF_KEY] = np.frombuffer(meta["conf"].encode(), dtype=np.uint8)
+    payload[_ITER_KEY] = np.asarray(meta["iteration"], np.int64)
+    if "rng" in tree:
+        payload[_RNG_KEY] = np.asarray(tree["rng"])
+        if meta.get("rng_impl"):
             payload[_RNG_IMPL_KEY] = np.frombuffer(
-                str(jax.random.key_impl(keys._key)).encode(), dtype=np.uint8
-            )
-        else:
-            payload[_RNG_KEY] = np.asarray(keys._key)
-    tmp = path + ".tmp.npz"
-    np.savez(tmp.removesuffix(".npz"), **payload)
-    os.replace(tmp, path)
+                meta["rng_impl"].encode(), dtype=np.uint8)
+    extra = {k: v for k, v in meta.items()
+             if k not in ("conf", "iteration", "rng_impl")}
+    if extra:
+        payload[_META_KEY] = np.frombuffer(
+            json.dumps(extra).encode(), dtype=np.uint8)
+
+    tmp = f"{path}.tmp-{os.getpid()}-{uuid.uuid4().hex}"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, **payload)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
     return path
 
 
 def load_checkpoint(path: str):
-    """Rebuild the network with params, updater state and iteration restored.
+    """Rebuild the network with params, updater state, RNG stream and
+    iteration restored. Returns (net, iteration).
 
-    Returns (net, iteration).
+    Strict: raises ``KeyError`` on a missing leaf, ``ValueError`` on a
+    shape mismatch, and ``TypeError`` on a lossy dtype narrowing (saved
+    float64 into a float32 template, etc.) — never a silent ``astype``.
     """
     from deeplearning4j_tpu.nn import functional as F
     from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.scaleout.ckpt.net_state import restore_net_state
+    from deeplearning4j_tpu.scaleout.ckpt.reshard import check_compatible
 
     if not path.endswith(".npz") and os.path.exists(path + ".npz"):
         path = path + ".npz"
@@ -86,7 +112,7 @@ def load_checkpoint(path: str):
         net = MultiLayerNetwork(conf).init()
         iteration = int(z[_ITER_KEY])
 
-        # rebuild templates, then fill leaves by path key
+        # rebuild templates, then fill leaves by path key — strictly
         params_template = net.params_tree
         state_template = F.init_train_state(conf, params_template)
 
@@ -96,24 +122,28 @@ def load_checkpoint(path: str):
             )
             new_leaves = []
             for p, leaf in leaves_with_paths:
-                key = _TREEDEF_PREFIX + jax.tree_util.keystr(p)
+                keystr = jax.tree_util.keystr(p)
+                key = _TREEDEF_PREFIX + keystr
                 if key not in z:
                     raise KeyError(f"checkpoint missing leaf {key}")
-                new_leaves.append(np.asarray(z[key]).astype(leaf.dtype))
+                saved = np.asarray(z[key])
+                dtype = check_compatible(saved.shape, str(saved.dtype),
+                                         leaf, keystr)
+                new_leaves.append(saved.astype(dtype, copy=False))
             return jax.tree_util.tree_unflatten(treedef, new_leaves)[label]
 
-        net._params = tuple(fill(params_template, "params"))
+        tree: Dict[str, Any] = {"params": fill(params_template, "params")}
         has_state = any(k.startswith(_TREEDEF_PREFIX + "['state']")
                         for k in z.files)
         if has_state:
-            net._train_state = tuple(fill(state_template, "state"))
-        net._iteration = iteration
+            tree["state"] = fill(state_template, "state")
+        meta: Dict[str, Any] = {"conf": conf.to_json(),
+                                "iteration": iteration}
         if _RNG_KEY in z.files:
-            raw = jax.numpy.asarray(z[_RNG_KEY], dtype=jax.numpy.uint32)
+            tree["rng"] = np.asarray(z[_RNG_KEY])
             if _RNG_IMPL_KEY in z.files:
-                # key was typed at save time: restore the same key flavor
-                impl = bytes(z[_RNG_IMPL_KEY]).decode()
-                net._keys._key = jax.random.wrap_key_data(raw, impl=impl)
-            else:
-                net._keys._key = raw
+                meta["rng_impl"] = bytes(z[_RNG_IMPL_KEY]).decode()
+        if _META_KEY in z.files:
+            meta.update(json.loads(bytes(z[_META_KEY]).decode()))
+        restore_net_state(net, tree, meta)
     return net, iteration

@@ -1,0 +1,55 @@
+"""Sharded, atomic, resumable checkpoints for the composed training paths.
+
+The legacy ``scaleout/checkpoint.py`` is a single-controller npz writer: it
+gathers every leaf to one host, so the dp×sp×ep / dp×pp flagship runs could
+not snapshot without materializing global state. This package is the
+subsystem that replaces it underneath (the legacy API stays as a thin
+single-file wrapper for single-device nets):
+
+- ``sharded_io.save_sharded`` — each device's slice of a sharded pytree is
+  written as its own chunk into per-shard npz files; a JSON ``MANIFEST``
+  (tree paths, global shapes, dtypes, sharding specs, mesh topology, step,
+  per-chunk CRCs) commits LAST via atomic rename — a checkpoint without a
+  committed manifest is invisible to every reader.
+- ``reshard.restore_sharded`` — restores into the *current* mesh even when
+  it differs from the save-time mesh (dp×sp×ep ↔ dp×pp ↔ single-device):
+  each target shard is assembled from the covering saved chunks via the
+  manifest offsets (``jax.make_array_from_callback``), never the full
+  global array on one host.
+- ``checkpointer.Checkpointer`` / ``CheckpointIterationListener`` — the
+  training integration: save-every-N through the exception-safe listener
+  chain, retention GC, ``latest()``/``restore()`` resume entry points, and
+  telemetry counters (save duration/bytes/shards) in the PR 2 registry.
+- ``net_state`` — capture/restore of the full MultiLayerNetwork training
+  state (params + updater state + RNG stream position + iteration), shared
+  by the listener and the legacy wrapper.
+
+Sharding the persisted optimizer/param state mirrors the weight-update
+sharding argument of arXiv:2004.13336; periodic fault-tolerant snapshots
+are the DeepSpark-style (arXiv:1602.08191) recovery mechanism.
+"""
+
+from deeplearning4j_tpu.scaleout.ckpt.manifest import (  # noqa: F401
+    MANIFEST_NAME,
+    Manifest,
+    read_manifest,
+    step_dir_name,
+)
+from deeplearning4j_tpu.scaleout.ckpt.sharded_io import (  # noqa: F401
+    save_sharded,
+)
+from deeplearning4j_tpu.scaleout.ckpt.reshard import (  # noqa: F401
+    latest_step,
+    latest_step_dir,
+    restore_sharded,
+    verify_checksums,
+)
+from deeplearning4j_tpu.scaleout.ckpt.checkpointer import (  # noqa: F401
+    Checkpointer,
+    CheckpointIterationListener,
+    replicated_shardings,
+)
+from deeplearning4j_tpu.scaleout.ckpt.net_state import (  # noqa: F401
+    capture_net_state,
+    restore_net_state,
+)

@@ -1,0 +1,88 @@
+"""Full MultiLayerNetwork training state as a checkpointable pytree.
+
+One capture/restore pair shared by every net-level persistence path — the
+``CheckpointIterationListener``, the legacy single-file
+``scaleout/checkpoint.py`` wrapper, and direct ``Checkpointer`` use — so
+what "complete training state" means (per-layer params, per-layer updater
+state, host RNG stream position, iteration counter, conf) is defined in
+exactly one place.
+
+Typed PRNG keys are stored as their raw key data plus an ``rng_impl`` meta
+string (key arrays are extension dtypes no serializer understands); raw
+uint32 keys pass through as-is.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def _key_is_typed(key) -> bool:
+    return jax.dtypes.issubdtype(key.dtype, jax.dtypes.prng_key)
+
+
+def capture_net_state(net, iteration: Optional[int] = None
+                      ) -> Tuple[Dict, Dict]:
+    """(state pytree, meta dict) for a MultiLayerNetwork.
+
+    The tree carries params, updater state (when initialized), and the RNG
+    stream position; meta carries the conf JSON, the iteration counter, and
+    the RNG key impl for typed keys.
+    """
+    tree: Dict = {"params": net.params_tree}
+    state = getattr(net, "_train_state", None)
+    if state is not None:
+        tree["state"] = state
+    meta: Dict = {"conf": net.conf.to_json()}
+    it = iteration if iteration is not None else getattr(net, "_iteration", 0)
+    meta["iteration"] = int(it)
+    keys = getattr(net, "_keys", None)
+    if keys is not None:
+        key = keys._key
+        if _key_is_typed(key):
+            tree["rng"] = np.asarray(jax.random.key_data(key))
+            meta["rng_impl"] = str(jax.random.key_impl(key))
+        else:
+            tree["rng"] = np.asarray(key)
+    return tree, meta
+
+
+def net_state_template(net) -> Dict:
+    """The template pytree a ``restore_sharded`` of a net checkpoint needs —
+    same structure ``capture_net_state`` produces for this net."""
+    tree, _meta = capture_net_state(net)
+    return tree
+
+
+def restore_net_state(net, tree: Dict, meta: Dict):
+    """Install a captured state tree into ``net`` (in place; returns net)."""
+    net._params = tuple(tree["params"])
+    if "state" in tree:
+        net._train_state = tuple(tree["state"])
+    net._iteration = int(meta.get("iteration", 0))
+    if "rng" in tree and getattr(net, "_keys", None) is not None:
+        raw = jax.numpy.asarray(np.asarray(tree["rng"]),
+                                dtype=jax.numpy.uint32)
+        impl = meta.get("rng_impl")
+        if impl:
+            net._keys._key = jax.random.wrap_key_data(raw, impl=impl)
+        else:
+            net._keys._key = raw
+    return net
+
+
+def rebuild_net(tree: Dict, meta: Dict):
+    """Reconstruct a fresh MultiLayerNetwork from a captured checkpoint
+    (conf JSON in meta) — the resume path when no live net exists."""
+    from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = MultiLayerConfiguration.from_json(meta["conf"])
+    net = MultiLayerNetwork(conf).init()
+    # make sure the updater-state template exists when the tree carries one
+    if "state" in tree:
+        net._ensure_train_step()
+    return restore_net_state(net, tree, meta)

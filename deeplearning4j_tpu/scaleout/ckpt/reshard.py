@@ -1,0 +1,227 @@
+"""Resharding checkpoint loader.
+
+Restores a saved pytree into the CURRENT mesh even when it differs from the
+save-time mesh (dp×sp×ep ↔ dp×pp ↔ single-device). For every leaf the
+target sharding decides which index rectangle each device needs, and that
+rectangle is assembled from the covering saved chunks via the manifest
+offsets (``jax.make_array_from_callback``) — the full global array is never
+materialized on one host unless the caller asks for an unsharded restore
+(``shardings=None`` for that leaf).
+
+Strictness (no silent corruption): a missing leaf, a shape mismatch, a
+lossy dtype narrowing, or an uncovered target region all raise — nothing is
+broadcast, truncated, or ``astype``-narrowed on the way in.
+"""
+
+from __future__ import annotations
+
+import os
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.scaleout.ckpt.manifest import (
+    LeafEntry,
+    Manifest,
+    committed_steps,
+    read_manifest,
+)
+
+
+def latest_step(root: str) -> Optional[int]:
+    """Highest COMMITTED step under root; interrupted (manifest-less)
+    directories are ignored."""
+    steps = committed_steps(root)
+    return steps[-1][0] if steps else None
+
+
+def latest_step_dir(root: str) -> Optional[str]:
+    steps = committed_steps(root)
+    return steps[-1][1] if steps else None
+
+
+def check_compatible(saved_shape: Tuple[int, ...], saved_dtype: str,
+                     template_leaf, path: str) -> np.dtype:
+    """Strict template check: exact shape, and dtype either identical or a
+    SAFE (lossless) cast to the template dtype. Returns the target dtype.
+
+    float64→float32, int64→int32 etc. are data-losing narrows and raise;
+    the legacy loader's silent ``astype`` let those corruptions surface as
+    late training divergence instead of a load-time error.
+    """
+    t_shape = tuple(int(d) for d in np.shape(template_leaf))
+    if tuple(saved_shape) != t_shape:
+        raise ValueError(
+            f"checkpoint leaf {path} has shape {tuple(saved_shape)} but the "
+            f"template expects {t_shape} — refusing to broadcast/truncate")
+    src = np.dtype(saved_dtype)
+    dst = np.dtype(getattr(template_leaf, "dtype", np.asarray(template_leaf).dtype))
+    if src == dst:
+        return dst
+    try:
+        safe = np.can_cast(src, dst, casting="safe")
+    except TypeError:  # extension dtypes (bfloat16) outside can_cast's table
+        safe = False
+    if not safe:
+        raise TypeError(
+            f"checkpoint leaf {path} was saved as {src} but the template is "
+            f"{dst} — a lossy dtype narrowing; restore into a matching-dtype "
+            "template instead")
+    return dst
+
+
+class _ChunkStore:
+    """Lazy per-file npz handles so a restore only reads the members the
+    target shards actually cover."""
+
+    def __init__(self, step_dir: str):
+        self.step_dir = step_dir
+        self._files: Dict[str, object] = {}
+
+    def get(self, fname: str, key: str) -> np.ndarray:
+        z = self._files.get(fname)
+        if z is None:
+            z = np.load(os.path.join(self.step_dir, fname))
+            self._files[fname] = z
+        return z[key]
+
+    def close(self) -> None:
+        for z in self._files.values():
+            z.close()
+        self._files = {}
+
+    def __enter__(self) -> "_ChunkStore":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _region_of(index, shape) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+    """An Index (tuple of slices, possibly open) → (starts, sizes)."""
+    if index is None:
+        return (0,) * len(shape), tuple(shape)
+    starts, sizes = [], []
+    for sl, dim in zip(index, shape):
+        start, stop, stride = sl.indices(dim)
+        if stride != 1:
+            raise ValueError(f"strided restore index {sl} is not supported")
+        starts.append(start)
+        sizes.append(stop - start)
+    return tuple(starts), tuple(sizes)
+
+
+def assemble_region(entry: LeafEntry, store: _ChunkStore, index,
+                    dtype: np.dtype) -> np.ndarray:
+    """Build the requested index rectangle of one leaf from the covering
+    saved chunks. Chunks from a sharding partition the global space, so
+    overlap volumes must sum to the region volume — anything less means a
+    corrupt/incomplete checkpoint and raises."""
+    starts, sizes = _region_of(index, entry.shape)
+    out = np.empty(sizes, dtype=dtype)
+    covered = 0
+    for chunk in entry.chunks:
+        lo = [max(s, cs) for s, cs in zip(starts, chunk.start)]
+        hi = [min(s + n, cs + cn)
+              for s, n, cs, cn in zip(starts, sizes, chunk.start, chunk.shape)]
+        if any(l >= h for l, h in zip(lo, hi)):
+            continue
+        data = store.get(chunk.file, chunk.key)
+        src = tuple(slice(l - cs, h - cs)
+                    for l, h, cs in zip(lo, hi, chunk.start))
+        dst = tuple(slice(l - s, h - s) for l, h, s in zip(lo, hi, starts))
+        out[dst] = np.asarray(data[src], dtype=dtype)
+        vol = 1
+        for l, h in zip(lo, hi):
+            vol *= h - l
+        covered += vol
+    if covered != out.size:
+        raise ValueError(
+            f"checkpoint leaf {entry.path}: saved chunks cover {covered} of "
+            f"{out.size} elements of the requested region — incomplete "
+            "checkpoint")
+    return out
+
+
+def restore_sharded(step_dir: str, template, shardings=None):
+    """Restore the pytree saved in ``step_dir`` into the structure of
+    ``template``. Returns ``(state, manifest)``.
+
+    ``shardings``: a pytree matching ``template`` of per-leaf target
+    ``jax.sharding.Sharding`` (or None entries). A leaf with a sharding is
+    built shard-by-shard via ``jax.make_array_from_callback`` — each device
+    assembles only ITS rectangle from the covering saved chunks, whatever
+    mesh the save ran on. A leaf without one is assembled whole and placed
+    as an ordinary (uncommitted) ``jnp`` array.
+
+    Strict by construction: missing leaves, shape mismatches, and lossy
+    dtype narrowing raise (see ``check_compatible``).
+    """
+    import jax.numpy as jnp
+
+    manifest = read_manifest(step_dir)
+    by_path = {entry.path: entry for entry in manifest.leaves}
+    t_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+    if shardings is None:
+        s_leaves = [None] * len(t_leaves)
+    else:
+        s_leaves = jax.tree_util.tree_flatten(
+            shardings, is_leaf=lambda x: x is None)[0]
+        if len(s_leaves) != len(t_leaves):
+            raise ValueError(
+                f"shardings pytree has {len(s_leaves)} leaves, template has "
+                f"{len(t_leaves)}")
+    new_leaves = []
+    with _ChunkStore(step_dir) as store:
+        for (path, t_leaf), sharding in zip(t_leaves, s_leaves):
+            key = jax.tree_util.keystr(path)
+            entry = by_path.get(key)
+            if entry is None:
+                raise KeyError(f"checkpoint is missing leaf {key}")
+            dtype = check_compatible(entry.shape, entry.dtype, t_leaf, key)
+            if sharding is None:
+                new_leaves.append(
+                    jnp.asarray(assemble_region(entry, store, None, dtype)))
+            else:
+                new_leaves.append(jax.make_array_from_callback(
+                    tuple(entry.shape), sharding,
+                    lambda idx, e=entry, d=dtype: assemble_region(
+                        e, store, idx, d)))
+    return jax.tree_util.tree_unflatten(
+        treedef, new_leaves), manifest
+
+
+def verify_checksums(step_dir: str) -> List[str]:
+    """Re-read every chunk and compare CRC32 against the manifest. Returns
+    a list of human-readable mismatch descriptions (empty = intact)."""
+    manifest = read_manifest(step_dir)
+    problems: List[str] = []
+    with _ChunkStore(step_dir) as store:
+        for entry in manifest.leaves:
+            for chunk in entry.chunks:
+                try:
+                    data = np.ascontiguousarray(
+                        store.get(chunk.file, chunk.key))
+                except Exception as e:  # missing file/member counts as corrupt
+                    problems.append(
+                        f"{entry.path} [{chunk.file}]: unreadable ({e})")
+                    continue
+                crc = zlib.crc32(data.tobytes())
+                if crc != chunk.crc32:
+                    problems.append(
+                        f"{entry.path} [{chunk.file}]: crc32 {crc} != "
+                        f"manifest {chunk.crc32}")
+                if tuple(data.shape) != chunk.shape:
+                    problems.append(
+                        f"{entry.path} [{chunk.file}]: stored shape "
+                        f"{tuple(data.shape)} != manifest {chunk.shape}")
+    return problems
+
+
+def _manifest_or_none(step_dir: str) -> Optional[Manifest]:
+    try:
+        return read_manifest(step_dir)
+    except (FileNotFoundError, ValueError):
+        return None

@@ -89,6 +89,33 @@ def test_bench_lm_composed_stage_on_cpu():
     assert telemetry["overhead_pct"] < 5.0, telemetry
 
 
+def test_bench_ckpt_stage_on_cpu():
+    """The sharded-checkpoint stage runs end to end on the CPU backend:
+    save MB/s as the headline rate plus restore timing, bytes, and
+    chunk/file counts in the stage detail — tier-1 guards the stage
+    plumbing (Checkpointer → manifest → resharding restore) without a
+    chip."""
+    env = dict(os.environ)
+    env["BENCH_FORCE_CPU"] = "1"
+    env["BENCH_FAST"] = "1"
+    env["BENCH_BUDGET_SEC"] = "150"
+    env["BENCH_ONLY"] = "ckpt"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=200, cwd=REPO, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    det = json.loads(out.stdout.strip().splitlines()[-1])["detail"]
+    assert det.get("ckpt_save_mb_per_sec"), det.get("ckpt_status")
+    stage_detail = det.get("ckpt_detail", {})
+    assert stage_detail.get("save_ms", 0) > 0
+    assert stage_detail.get("restore_ms", 0) > 0
+    assert stage_detail.get("mb", 0) > 0
+    assert stage_detail.get("chunks", 0) > 0
+    assert stage_detail.get("shard_files", 0) >= 1
+    assert stage_detail.get("restore_mb_per_sec", 0) > 0
+
+
 def test_bench_skips_stages_past_deadline():
     env = dict(os.environ)
     env["BENCH_FORCE_CPU"] = "1"

@@ -1,0 +1,280 @@
+"""Sharded checkpoint subsystem (scaleout/ckpt): manifest atomicity,
+resharding restore, strictness, retention, checksums, telemetry, and the
+ckpt_inspect CLI."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.models.transformer_lm import (
+    init_lm_params,
+    lm_param_shardings,
+    shard_lm_params,
+)
+from deeplearning4j_tpu.scaleout.ckpt import (
+    Checkpointer,
+    latest_step,
+    restore_sharded,
+    save_sharded,
+    verify_checksums,
+)
+from deeplearning4j_tpu.scaleout.ckpt.manifest import (
+    MANIFEST_NAME,
+    read_manifest,
+    step_dir_name,
+)
+from deeplearning4j_tpu.telemetry.registry import MetricsRegistry
+
+V, D, H, E, DFF = 32, 16, 2, 4, 32
+
+
+def _params(n_layers=1, n_experts=E, seed=0):
+    return init_lm_params(jax.random.PRNGKey(seed), V, D, H, n_experts, DFF,
+                          n_layers=n_layers)
+
+
+def _dp_ep_mesh():
+    return Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                ("data", "expert"))
+
+
+def _dp_sp_ep_mesh():
+    return Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                ("data", "sp", "expert"))
+
+
+def _assert_tree_equal(a, b, what, atol=0.0):
+    fa = jax.tree_util.tree_leaves_with_path(a)
+    fb = jax.tree_util.tree_leaves_with_path(b)
+    assert len(fa) == len(fb)
+    for (pa, la), (_, lb) in zip(fa, fb):
+        err = float(jnp.max(jnp.abs(jnp.asarray(la, jnp.float32)
+                                    - jnp.asarray(lb, jnp.float32))))
+        assert err <= atol, f"{what}: {jax.tree_util.keystr(pa)} diff {err}"
+
+
+class TestShardedRoundTrip:
+    def test_replicated_roundtrip_exact(self, tmp_path):
+        state = {"params": _params(), "extra": jnp.arange(7.0)}
+        step_dir = save_sharded(str(tmp_path), 5, state)
+        restored, manifest = restore_sharded(step_dir, state)
+        assert manifest.step == 5
+        _assert_tree_equal(restored, state, "replicated roundtrip")
+
+    def test_sharded_save_writes_per_shard_chunks(self, tmp_path):
+        mesh = _dp_ep_mesh()
+        sharded = shard_lm_params(_params(), mesh)
+        step_dir = save_sharded(str(tmp_path), 1, {"params": sharded},
+                                mesh=mesh)
+        manifest = read_manifest(step_dir)
+        assert manifest.mesh == {"axis_names": ["data", "expert"],
+                                 "shape": [2, 4]}
+        by_path = {e.path: e for e in manifest.leaves}
+        # expert-sharded leaves split into one chunk per expert shard;
+        # replicated leaves dedupe to exactly ONE chunk
+        assert len(by_path["['params']['blocks']['experts']['w1']"].chunks) == 4
+        assert len(by_path["['params']['embed']"].chunks) == 1
+        assert by_path["['params']['blocks']['experts']['w1']"].spec == [
+            None, "expert"]
+        # one file per owning device, all referenced by the manifest
+        for fname in manifest.files:
+            assert os.path.isfile(os.path.join(step_dir, fname))
+
+    def test_reshard_across_meshes_and_to_single_device(self, tmp_path):
+        """The resharding matrix: dp×ep save → dp×sp×ep restore and →
+        unsharded restore, both bit-exact, target shards assembled from
+        the covering saved slices."""
+        params = _params(n_layers=2)
+        mesh_a = _dp_ep_mesh()
+        step_dir = save_sharded(str(tmp_path), 2,
+                                {"params": shard_lm_params(params, mesh_a)},
+                                mesh=mesh_a)
+
+        mesh_b = _dp_sp_ep_mesh()
+        template = {"params": _params(n_layers=2, seed=9)}  # values ignored
+        shardings = {"params": lm_param_shardings(template["params"], mesh_b)}
+        restored, _ = restore_sharded(step_dir, template, shardings)
+        _assert_tree_equal(restored["params"], params, "dp×ep → dp×sp×ep")
+        w1 = restored["params"]["blocks"]["experts"]["w1"]
+        assert w1.sharding.spec == P(None, "expert")
+        assert w1.sharding.mesh.axis_names == ("data", "sp", "expert")
+
+        unsharded, _ = restore_sharded(step_dir, template, None)
+        _assert_tree_equal(unsharded["params"], params, "dp×ep → unsharded")
+
+    def test_save_time_sharding_is_irrelevant_to_restore(self, tmp_path):
+        """Same values saved replicated and expert-sharded restore
+        identically — chunk offsets, not save-time layout, drive
+        assembly."""
+        params = _params()
+        mesh = _dp_ep_mesh()
+        d_rep = save_sharded(str(tmp_path / "rep"), 1, {"params": params})
+        d_shd = save_sharded(str(tmp_path / "shd"), 1,
+                             {"params": shard_lm_params(params, mesh)},
+                             mesh=mesh)
+        t = {"params": _params(seed=3)}
+        sh = {"params": lm_param_shardings(t["params"], mesh)}
+        a, _ = restore_sharded(d_rep, t, sh)
+        b, _ = restore_sharded(d_shd, t, sh)
+        _assert_tree_equal(a, b, "layout-independent restore")
+
+
+class TestAtomicityAndLatest:
+    def test_manifestless_dir_is_invisible_to_latest(self, tmp_path):
+        ck = Checkpointer(str(tmp_path), registry=MetricsRegistry())
+        assert ck.latest_step() is None
+        ck.save(3, {"x": jnp.ones(4)})
+        # an interrupted save: step dir + data file, NO manifest
+        fake = tmp_path / step_dir_name(9)
+        fake.mkdir()
+        (fake / "shard_00000.npz").write_bytes(b"partial garbage")
+        assert ck.latest_step() == 3
+        assert latest_step(str(tmp_path)) == 3
+        state, step, _meta = ck.restore({"x": jnp.zeros(4)})
+        assert step == 3
+        np.testing.assert_array_equal(np.asarray(state["x"]), np.ones(4))
+
+    def test_manifest_commits_last(self, tmp_path, monkeypatch):
+        """Kill the writer right before the manifest rename: the directory
+        exists but no reader sees a checkpoint."""
+        from deeplearning4j_tpu.scaleout.ckpt import manifest as mf
+
+        def boom(step_dir, manifest):
+            raise RuntimeError("killed before commit")
+
+        monkeypatch.setattr(
+            "deeplearning4j_tpu.scaleout.ckpt.sharded_io.write_manifest",
+            boom)
+        with pytest.raises(RuntimeError):
+            save_sharded(str(tmp_path), 7, {"x": jnp.ones(3)})
+        step_dir = tmp_path / step_dir_name(7)
+        assert step_dir.is_dir()  # data landed...
+        assert not (step_dir / MANIFEST_NAME).exists()  # ...but no commit
+        assert latest_step(str(tmp_path)) is None
+
+    def test_superseding_save_sweeps_interrupted_dir(self, tmp_path):
+        ck = Checkpointer(str(tmp_path), registry=MetricsRegistry())
+        fake = tmp_path / step_dir_name(4)
+        fake.mkdir()
+        (fake / "shard_00000.npz").write_bytes(b"junk")
+        ck.save(5, {"x": jnp.ones(2)})
+        assert not fake.exists(), "superseded interrupted save must be GC'd"
+
+
+class TestStrictness:
+    def test_shape_mismatch_raises(self, tmp_path):
+        step_dir = save_sharded(str(tmp_path), 1, {"w": jnp.ones((4, 4))})
+        with pytest.raises(ValueError, match="shape"):
+            restore_sharded(step_dir, {"w": jnp.ones((4, 5))})
+
+    def test_lossy_dtype_narrowing_raises(self, tmp_path):
+        # float64 state written from host numpy (x64 stays off in jax)
+        step_dir = save_sharded(
+            str(tmp_path), 1, {"w": np.ones((3,), np.float64)})
+        with pytest.raises(TypeError, match="narrow"):
+            restore_sharded(step_dir, {"w": jnp.ones((3,), jnp.float32)})
+
+    def test_safe_widening_is_allowed(self, tmp_path):
+        step_dir = save_sharded(
+            str(tmp_path), 1, {"w": np.asarray([1, 2, 3], np.int8)})
+        restored, _ = restore_sharded(
+            step_dir, {"w": jnp.zeros((3,), jnp.int32)})
+        assert restored["w"].dtype == jnp.int32
+        np.testing.assert_array_equal(np.asarray(restored["w"]), [1, 2, 3])
+
+    def test_missing_leaf_raises(self, tmp_path):
+        step_dir = save_sharded(str(tmp_path), 1, {"a": jnp.ones(2)})
+        with pytest.raises(KeyError, match="missing leaf"):
+            restore_sharded(step_dir, {"a": jnp.ones(2), "b": jnp.ones(2)})
+
+
+class TestRetentionAndTelemetry:
+    def test_retention_keeps_last_n(self, tmp_path):
+        ck = Checkpointer(str(tmp_path), keep_last=2,
+                          registry=MetricsRegistry())
+        for step in (1, 2, 3, 4):
+            ck.save(step, {"x": jnp.full((2,), float(step))})
+        steps = [s for s, _ in ck.step_dirs()]
+        assert steps == [3, 4]
+        state, step, _ = ck.restore({"x": jnp.zeros(2)})
+        assert step == 4
+
+    def test_save_restore_bump_registry(self, tmp_path):
+        reg = MetricsRegistry()
+        ck = Checkpointer(str(tmp_path), registry=reg, prefix="ckpt")
+        ck.save(10, {"x": jnp.ones((8, 8))})
+        assert reg.counter("ckpt_saves_total").value == 1
+        assert reg.counter("ckpt_bytes_total").value == 8 * 8 * 4
+        assert reg.gauge("ckpt_last_step").value == 10
+        assert reg.gauge("ckpt_last_shards").value >= 1
+        assert reg.histogram("ckpt_save_ms").count == 1
+        ck.restore({"x": jnp.zeros((8, 8))})
+        assert reg.counter("ckpt_restores_total").value == 1
+        assert reg.histogram("ckpt_restore_ms").count == 1
+
+    def test_verify_checksums_detects_corruption(self, tmp_path):
+        ck = Checkpointer(str(tmp_path), registry=MetricsRegistry(),
+                          verify_on_restore=True)
+        step_dir = ck.save(1, {"x": jnp.arange(32.0)})
+        assert verify_checksums(step_dir) == []
+        # corrupt one stored chunk (rewrite the member with different data)
+        fname = os.path.join(step_dir, "shard_00000.npz")
+        with np.load(fname) as z:
+            payload = {k: np.asarray(z[k]) for k in z.files}
+        key = list(payload)[0]
+        payload[key] = payload[key] + 1.0
+        with open(fname, "wb") as f:
+            np.savez(f, **payload)
+        problems = verify_checksums(step_dir)
+        assert problems and "crc32" in problems[0]
+        with pytest.raises(ValueError, match="checksum"):
+            ck.restore({"x": jnp.zeros(32)})
+
+
+class TestCkptInspectCli:
+    def _saved(self, tmp_path):
+        ck = Checkpointer(str(tmp_path), registry=MetricsRegistry())
+        ck.save(2, {"params": _params()})
+        return str(tmp_path)
+
+    def test_summary_and_verify(self, tmp_path, capsys):
+        from tools.ckpt_inspect import main
+
+        root = self._saved(tmp_path)
+        assert main([root]) == 0
+        out = capsys.readouterr().out
+        assert "step 2" in out and "['params']['embed']" in out
+        assert main([root, "--verify"]) == 0
+        assert "ok:" in capsys.readouterr().out
+        assert main([root, "--json"]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["step"] == 2 and payload["leaves"] > 0
+
+    def test_diff(self, tmp_path, capsys):
+        from tools.ckpt_inspect import main
+
+        ck = Checkpointer(str(tmp_path), keep_last=5,
+                          registry=MetricsRegistry())
+        d1 = ck.save(1, {"params": _params()})
+        d2 = ck.save(
+            2, {"params": jax.tree_util.tree_map(lambda a: a + 1.0,
+                                                 _params())})
+        assert main([d1, "--diff", d1]) == 0
+        assert "identical" in capsys.readouterr().out
+        assert main([d1, "--diff", d2, "--json"]) == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert not payload["identical"]
+        assert payload["max_abs_diff"] == pytest.approx(1.0)
+
+    def test_interrupted_dir_rejected(self, tmp_path, capsys):
+        from tools.ckpt_inspect import main
+
+        fake = tmp_path / step_dir_name(1)
+        fake.mkdir()
+        assert main([str(tmp_path)]) == 2
+        assert "interrupted" in capsys.readouterr().err

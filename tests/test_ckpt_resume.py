@@ -1,0 +1,354 @@
+"""Kill/resume parity through the sharded checkpoint subsystem.
+
+Each test runs the SAME deterministic training twice: uninterrupted, and
+killed mid-training + resumed from the checkpoint (fresh step builders,
+fresh templates — nothing survives the 'kill' but the files on disk). The
+two trajectories must agree on every post-resume loss and on the final
+params to 1e-6 or better; the only delta between the branches is the
+checkpoint round-trip, so any divergence is checkpoint infidelity, not
+math noise. Covers the acceptance matrix: same-mesh resume, dp×pp save →
+dp×sp×ep resume, dp×ep save → single-device resume, plus the trainer
+facade and RNG-stream resume (typed AND raw key flavors)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.models.transformer_lm import (
+    init_lm_params,
+    lm_param_shardings,
+    make_composed_train_step,
+    make_pp_loss,
+    make_pp_stages,
+    make_single_device_train_step,
+    pp_trained_to_lm_params,
+    shard_lm_batch,
+    shard_lm_params,
+)
+from deeplearning4j_tpu.scaleout.ckpt import Checkpointer
+from deeplearning4j_tpu.telemetry.registry import MetricsRegistry
+
+V, D, H, DFF = 32, 16, 2, 32
+B, T = 4, 16
+ATOL = 1e-6  # the acceptance bound; the round-trip is byte-exact in practice
+
+
+def _params(n_experts=4, n_layers=1):
+    return init_lm_params(jax.random.PRNGKey(0), V, D, H, n_experts, DFF,
+                          n_layers=n_layers)
+
+
+def _step_data(i, batch=B, seq=T):
+    """Deterministic per-step batch: both the uninterrupted and the resumed
+    run regenerate the identical stream from the step index alone."""
+    k = jax.random.fold_in(jax.random.PRNGKey(7), i)
+    toks = jax.random.randint(k, (batch, seq + 1), 0, V)
+    return toks[:, :-1], toks[:, 1:]
+
+
+def _ck(tmp_path):
+    return Checkpointer(str(tmp_path), keep_last=3,
+                        registry=MetricsRegistry())
+
+
+def _assert_close(a, b, what, atol=ATOL):
+    fa = jax.tree_util.tree_leaves_with_path(a)
+    fb = jax.tree_util.tree_leaves_with_path(b)
+    assert len(fa) == len(fb)
+    for (pa, la), (_, lb) in zip(fa, fb):
+        err = float(jnp.max(jnp.abs(jnp.asarray(la, jnp.float32)
+                                    - jnp.asarray(lb, jnp.float32))))
+        assert err <= atol, f"{what}: {jax.tree_util.keystr(pa)} diff {err}"
+
+
+def _dp_ep_mesh():
+    return Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                ("data", "expert"))
+
+
+def _dp_sp_ep_mesh(e=2):
+    return Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                ("data", "sp", "expert"))
+
+
+def test_same_mesh_kill_resume_parity(tmp_path):
+    """dp2×ep4 composed-LM run checkpointed at step 3, killed, resumed on
+    the SAME mesh: steps 4-6 losses and final params match the
+    uninterrupted run to 1e-6."""
+    mesh = _dp_ep_mesh()
+    capacity = (B // 2) * T
+
+    def run(params, start, n, step_fn, losses):
+        for i in range(start, start + n):
+            tk, tg = shard_lm_batch(*_step_data(i), mesh)
+            params, loss = step_fn(params, tk, tg)
+            jax.block_until_ready(loss)
+            losses.append(float(loss))
+        return params
+
+    # uninterrupted: 6 steps
+    step = make_composed_train_step(mesh, H, capacity)
+    ref_losses = []
+    ref = run(shard_lm_params(_params(), mesh), 0, 6, step, ref_losses)
+
+    # interrupted twin: 3 steps, save, KILL (drop everything), resume
+    ck = _ck(tmp_path)
+    mid_losses = []
+    mid = run(shard_lm_params(_params(), mesh), 0, 3, step, mid_losses)
+    ck.save(3, {"params": mid}, meta={"note": "mid-training"}, mesh=mesh)
+    del mid
+
+    template = {"params": _params()}  # fresh template; values irrelevant
+    shardings = {"params": lm_param_shardings(template["params"], mesh)}
+    state, resumed_step, meta = ck.restore(template, shardings)
+    assert resumed_step == 3 and meta["note"] == "mid-training"
+    step2 = make_composed_train_step(mesh, H, capacity)  # fresh builder
+    res_losses = []
+    resumed = run(state["params"], 3, 3, step2, res_losses)
+
+    np.testing.assert_allclose(res_losses, ref_losses[3:], atol=ATOL, rtol=0)
+    _assert_close(resumed, ref, "same-mesh resume params")
+
+
+def test_dp_pp_save_resumes_on_dp_sp_ep(tmp_path):
+    """dp2×pp2 training for 3 steps → canonical-params checkpoint → killed
+    → resumed onto a dp2×sp2×ep2 mesh and trained 3 more composed steps.
+    The uninterrupted twin does the identical mesh hand-off in memory, so
+    the only difference is the checkpoint round-trip."""
+    n_layers, n_stages = 2, 2
+    n_experts = 2
+    mesh_pp = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                   ("data", "pipe"))
+    params = _params(n_experts=n_experts, n_layers=n_layers)
+    per_stage, stage_fn = make_pp_stages(params, H, n_stages=n_stages)
+    from deeplearning4j_tpu.parallel.pipeline import (
+        shard_stage_params,
+        stack_stage_params,
+    )
+
+    stacked = shard_stage_params(stack_stage_params(per_stage), mesh_pp,
+                                 "pipe")
+    pipe_loss = make_pp_loss(stage_fn, mesh_pp, "pipe", batch_axis="data")
+    pipe_vg = jax.jit(jax.value_and_grad(pipe_loss))
+    lr = 0.1
+    n_micro, mb = 4, 2
+
+    trained = (stacked, params["embed"], params["dec_w"], params["dec_b"])
+    for i in range(3):
+        tk, tg = _step_data(i, batch=n_micro * mb)
+        tk = tk.reshape(n_micro, mb, T)
+        tg = tg.reshape(n_micro, mb, T)
+        loss, grads = pipe_vg(trained, tk, tg)
+        trained = jax.tree_util.tree_map(lambda p, g: p - lr * g,
+                                         trained, grads)
+        jax.block_until_ready(loss)
+
+    # checkpoint boundary: persist the CANONICAL layout, not the staging
+    canonical = pp_trained_to_lm_params(trained)
+    ck = _ck(tmp_path)
+    ck.save(3, {"params": canonical}, mesh=mesh_pp)
+
+    # continuation config shared by both branches
+    mesh_sp = _dp_sp_ep_mesh()
+    capacity = (8 // 2) * (T // 2)
+
+    def continue_composed(start_params, step_fn):
+        p, losses = start_params, []
+        for i in range(3, 6):
+            tk, tg = shard_lm_batch(*_step_data(i, batch=8), mesh_sp)
+            p, loss = step_fn(p, tk, tg)
+            jax.block_until_ready(loss)
+            losses.append(float(loss))
+        return p, losses
+
+    # uninterrupted twin: same hand-off, no disk
+    step_a = make_composed_train_step(mesh_sp, H, capacity)
+    ref, ref_losses = continue_composed(
+        shard_lm_params(canonical, mesh_sp), step_a)
+
+    # resumed branch: fresh template, restore resharded onto the new mesh
+    template = {"params": _params(n_experts=n_experts, n_layers=n_layers)}
+    shardings = {"params": lm_param_shardings(template["params"], mesh_sp)}
+    state, step_no, _ = ck.restore(template, shardings)
+    assert step_no == 3
+    w1 = state["params"]["blocks"]["experts"]["w1"]
+    assert w1.sharding.mesh.axis_names == ("data", "sp", "expert")
+    step_b = make_composed_train_step(mesh_sp, H, capacity)
+    resumed, res_losses = continue_composed(state["params"], step_b)
+
+    np.testing.assert_allclose(res_losses, ref_losses, atol=ATOL, rtol=0)
+    _assert_close(resumed, ref, "dp×pp → dp×sp×ep resume params")
+
+
+def test_dp_ep_save_resumes_on_single_device(tmp_path):
+    """dp2×ep4 composed training checkpointed at step 3, resumed UNSHARDED
+    on a single device (dense step). The twin hands the same params over
+    in memory; post-resume trajectories must match to 1e-6."""
+    mesh = _dp_ep_mesh()
+    capacity = (B // 2) * T
+    step = make_composed_train_step(mesh, H, capacity)
+    p = shard_lm_params(_params(), mesh)
+    for i in range(3):
+        tk, tg = shard_lm_batch(*_step_data(i), mesh)
+        p, loss = step(p, tk, tg)
+        jax.block_until_ready(loss)
+    ck = _ck(tmp_path)
+    ck.save(3, {"params": p}, mesh=mesh)
+
+    def continue_single(start_params, step_fn):
+        q, losses = start_params, []
+        for i in range(3, 6):
+            tk, tg = _step_data(i)
+            q, loss = step_fn(q, tk, tg)
+            losses.append(float(loss))
+        return q, losses
+
+    sd_step = make_single_device_train_step(H)
+    ref, ref_losses = continue_single(
+        jax.tree_util.tree_map(jnp.asarray, jax.device_get(p)), sd_step)
+
+    template = {"params": _params()}
+    state, _, _ = ck.restore(template, shardings=None)  # unsharded restore
+    sd_step2 = make_single_device_train_step(H)
+    resumed, res_losses = continue_single(state["params"], sd_step2)
+
+    np.testing.assert_allclose(res_losses, ref_losses, atol=ATOL, rtol=0)
+    _assert_close(resumed, ref, "dp×ep → single-device resume params")
+
+
+# ------------------------------------------------------- trainer facade ----
+
+def _mlp_conf(num_iterations=1, dropout=0.0, seed=11):
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+
+    builder = (NeuralNetConfiguration.Builder()
+               .n_in(4).n_out(8).activation_function("tanh").lr(0.1)
+               .num_iterations(num_iterations).seed(seed).weight_init("VI"))
+    if dropout:
+        builder = builder.dropout(dropout)
+    return (builder.list(2)
+            .override(0, layer_type="DENSE")
+            .override(1, layer_type="OUTPUT", n_in=8, n_out=3,
+                      activation_function="softmax", loss_function="MCXENT")
+            .pretrain(False).backward(True).build())
+
+
+def _iris_batches():
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(32, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 32)]
+    return ListDataSetIterator(
+        [DataSet(x[i:i + 8], y[i:i + 8]) for i in range(0, 32, 8)])
+
+
+def test_parameter_averaging_trainer_kill_resume(tmp_path):
+    """The DP trainer facade: checkpoint through the listener chain every
+    4 sync iterations, kill, resume into a FRESH net+trainer, finish the
+    second pass — params match the uninterrupted twin to 1e-6 (updater
+    state, iteration counter, and the host RNG stream all resumed)."""
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
+    from deeplearning4j_tpu.parallel.trainer import ParameterAveragingTrainer
+
+    mesh = data_parallel_mesh(4)
+
+    # uninterrupted: two passes over the data
+    net_a = MultiLayerNetwork(_mlp_conf()).init()
+    tr_a = ParameterAveragingTrainer(net_a, mesh,
+                                     average_each_iteration=True)
+    tr_a.fit_data_set(_iris_batches())
+    tr_a.fit_data_set(_iris_batches())
+
+    # interrupted: first pass with periodic checkpoints (4 batches → one
+    # save at iteration 4 through the listener chain), then KILL
+    ck = _ck(tmp_path)
+    net_b = MultiLayerNetwork(_mlp_conf()).init()
+    tr_b = ParameterAveragingTrainer(net_b, mesh,
+                                     average_each_iteration=True,
+                                     checkpointer=ck, checkpoint_every=4)
+    tr_b.fit_data_set(_iris_batches())
+    assert ck.latest_step() == 4
+    del net_b, tr_b
+
+    # resume in a fresh process-equivalent: new net, new trainer
+    net_c = MultiLayerNetwork(_mlp_conf()).init()
+    tr_c = ParameterAveragingTrainer(net_c, mesh,
+                                     average_each_iteration=True)
+    resumed_step = tr_c.resume(ck)
+    assert resumed_step == 4 and tr_c._iteration == 4
+    tr_c.fit_data_set(_iris_batches())
+
+    np.testing.assert_allclose(np.asarray(net_a.params()),
+                               np.asarray(net_c.params()), atol=ATOL)
+
+
+def test_trainer_resume_without_checkpoint_is_noop(tmp_path):
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
+    from deeplearning4j_tpu.parallel.trainer import ParameterAveragingTrainer
+
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    tr = ParameterAveragingTrainer(net, data_parallel_mesh(2))
+    assert tr.resume(_ck(tmp_path)) is None
+    assert tr._iteration == 0
+
+
+# ------------------------------------------------- RNG-stream resume ----
+
+@pytest.mark.parametrize("flavor", ["raw", "typed"])
+def test_rng_stream_resume_through_subsystem(tmp_path, flavor):
+    """A dropout conf saved at step k through the NEW subsystem and
+    resumed must produce the same step-k+1..n losses as an uninterrupted
+    run — the host RNG stream position round-trips for BOTH key flavors
+    (raw uint32 and typed PRNG key arrays)."""
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optimize.listeners import CollectScoresListener
+    from deeplearning4j_tpu.scaleout.ckpt import CheckpointIterationListener
+
+    conf = _mlp_conf(num_iterations=5, dropout=0.3)
+    rng = np.random.RandomState(0)
+    x = rng.rand(16, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 16)]
+
+    def make_net():
+        net = MultiLayerNetwork(conf).init()
+        if flavor == "typed":
+            net._keys._key = jax.random.key(conf.conf(0).seed)
+        return net
+
+    # uninterrupted: 10 iterations, record the step 6..10 losses
+    net_a = make_net()
+    scores_a = CollectScoresListener()
+    net_a.listeners.append(scores_a)
+    net_a.fit(x, y)
+    net_a.fit(x, y)
+
+    # interrupted: save at iteration 5 through the listener chain, kill,
+    # rebuild the net from the checkpoint alone, run 5 more
+    ck = _ck(tmp_path)
+    net_b = make_net()
+    net_b.listeners.append(CheckpointIterationListener(ck, save_every=5))
+    net_b.fit(x, y)
+    assert ck.latest_step() == 5
+    del net_b
+
+    net_c, it = ck.restore_net()
+    assert it == 5
+    if flavor == "typed":
+        assert jax.dtypes.issubdtype(net_c._keys._key.dtype,
+                                     jax.dtypes.prng_key), (
+            "typed key flavor must survive the round-trip")
+    scores_c = CollectScoresListener()
+    net_c.listeners.append(scores_c)
+    net_c.fit(x, y)
+
+    tail_a = [s for i, s in scores_a.scores if i > 5]
+    tail_c = [s for _i, s in scores_c.scores]
+    np.testing.assert_allclose(tail_c, tail_a, atol=ATOL, rtol=0)
+    np.testing.assert_allclose(np.asarray(net_a.params()),
+                               np.asarray(net_c.params()), atol=ATOL)

@@ -2,7 +2,10 @@
 (ref test model: TestDistributed / BaseTestDistributed in-JVM harness,
 SURVEY.md §4)."""
 
+import os
+
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu.datasets.impl import IrisDataSetIterator
 from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
@@ -322,6 +325,113 @@ class TestFullStateCheckpoint:
         net_b.fit(x, y)
         np.testing.assert_allclose(np.asarray(net_a.params()),
                                    np.asarray(net_b.params()), atol=1e-6)
+
+    def test_crash_mid_save_preserves_old_checkpoint(self, tmp_path,
+                                                     monkeypatch):
+        """A writer killed mid-save must leave the previous checkpoint at
+        the path intact and loadable, and clean up its tmp file — the
+        unique-tmp + os.replace discipline."""
+        import numpy as np
+
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.scaleout.checkpoint import (
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        rng = np.random.RandomState(3)
+        x = rng.rand(12, 4).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 12)]
+        net = MultiLayerNetwork(self._conf()).init()
+        net.fit(x, y)
+        path = save_checkpoint(str(tmp_path / "ck"), net)
+        with open(path, "rb") as f:
+            good_bytes = f.read()
+
+        net.fit(x, y)
+
+        def boom(f, **payload):
+            f.write(b"half a checkpoint")  # partial write, then crash
+            raise RuntimeError("disk died mid-save")
+
+        monkeypatch.setattr(np, "savez", boom)
+        with pytest.raises(RuntimeError, match="disk died"):
+            save_checkpoint(path, net)
+        monkeypatch.undo()
+
+        with open(path, "rb") as f:
+            assert f.read() == good_bytes, "old checkpoint was clobbered"
+        leftovers = [p for p in os.listdir(tmp_path) if ".tmp-" in p]
+        assert not leftovers, f"tmp files left behind: {leftovers}"
+        net2, it = load_checkpoint(path)
+        assert it == 10
+        assert np.isfinite(np.asarray(net2.params())).all()
+
+    def test_concurrent_saver_tmp_names_are_unique(self, tmp_path,
+                                                   monkeypatch):
+        """Two savers writing the same path must not collide on the tmp
+        file (the old fixed ``path.tmp.npz`` name did)."""
+        import numpy as np
+
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.scaleout.checkpoint import save_checkpoint
+
+        net = MultiLayerNetwork(self._conf()).init()
+        seen = []
+        orig = np.savez
+
+        def spy(f, **payload):
+            seen.append(f.name)
+            return orig(f, **payload)
+
+        monkeypatch.setattr(np, "savez", spy)
+        path = str(tmp_path / "ck")
+        save_checkpoint(path, net)
+        save_checkpoint(path, net)
+        assert len(seen) == 2 and seen[0] != seen[1]
+        assert all(".tmp-" in name for name in seen)
+
+    def test_load_rejects_shape_mismatch_and_lossy_dtype(self, tmp_path):
+        """Satellite: the loader must raise on a shape mismatch and on a
+        lossy dtype narrowing instead of silently astype-ing into the
+        template (safe widening still loads)."""
+        import numpy as np
+
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.scaleout.checkpoint import (
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        net = MultiLayerNetwork(self._conf()).init()
+        path = save_checkpoint(str(tmp_path / "ck"), net)
+        with np.load(path) as z:
+            payload = {k: np.asarray(z[k]) for k in z.files}
+        param_keys = [k for k in payload
+                      if k.startswith("tree::['params']")
+                      and payload[k].ndim == 2]
+        key = param_keys[0]
+
+        bad_shape = dict(payload)
+        bad_shape[key] = payload[key][:-1]  # truncate one row
+        p1 = str(tmp_path / "bad_shape.npz")
+        np.savez(p1.removesuffix(".npz"), **bad_shape)
+        with pytest.raises(ValueError, match="shape"):
+            load_checkpoint(p1)
+
+        bad_dtype = dict(payload)
+        bad_dtype[key] = payload[key].astype(np.float64)
+        p2 = str(tmp_path / "bad_dtype.npz")
+        np.savez(p2.removesuffix(".npz"), **bad_dtype)
+        with pytest.raises(TypeError, match="narrow"):
+            load_checkpoint(p2)
+
+        widened = dict(payload)
+        widened[key] = payload[key].astype(np.float16)  # f16 → f32 is safe
+        p3 = str(tmp_path / "widened.npz")
+        np.savez(p3.removesuffix(".npz"), **widened)
+        net3, _ = load_checkpoint(p3)
+        assert np.isfinite(np.asarray(net3.params())).all()
 
 
 class TestFaultTolerance:

@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Inspect sharded checkpoints (scaleout/ckpt): manifest, checksums, diff.
+
+Usage:
+    python tools/ckpt_inspect.py CKPT             # manifest summary
+    python tools/ckpt_inspect.py CKPT --verify    # re-read + CRC every chunk
+    python tools/ckpt_inspect.py A --diff B       # structural + value diff
+    ... --json                                    # machine output
+
+``CKPT`` is either a checkpoint root (the latest COMMITTED step is picked;
+manifest-less interrupted saves are ignored, exactly as ``latest_step``
+does for a resume) or a specific ``step_*`` directory. Exit codes: 0 ok,
+1 verification failed / checkpoints differ, 2 usage or missing input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu.scaleout.ckpt.manifest import (  # noqa: E402
+    has_manifest,
+    read_manifest,
+)
+from deeplearning4j_tpu.scaleout.ckpt.reshard import (  # noqa: E402
+    _ChunkStore,
+    assemble_region,
+    latest_step_dir,
+    verify_checksums,
+)
+
+
+def resolve_step_dir(path: str) -> str:
+    """A root (pick latest committed) or a step dir (must be committed)."""
+    if has_manifest(path):
+        return path
+    step_dir = latest_step_dir(path)
+    if step_dir is None:
+        raise FileNotFoundError(
+            f"{path}: no committed checkpoint (a directory without a "
+            "MANIFEST.json is an interrupted save, not a checkpoint)")
+    return step_dir
+
+
+def summarize(step_dir: str) -> dict:
+    m = read_manifest(step_dir)
+    return {
+        "dir": step_dir,
+        "format": m.format,
+        "step": m.step,
+        "mesh": m.mesh,
+        "meta_keys": sorted((m.meta or {}).keys()),
+        "leaves": len(m.leaves),
+        "chunks": sum(len(e.chunks) for e in m.leaves),
+        "files": len(m.files),
+        "bytes": m.total_bytes,
+    }
+
+
+def format_summary(step_dir: str) -> str:
+    m = read_manifest(step_dir)
+    s = summarize(step_dir)
+    lines = [f"checkpoint {step_dir}",
+             f"  format {s['format']}  step {s['step']}  "
+             f"mesh {s['mesh']}",
+             f"  {s['leaves']} leaves, {s['chunks']} chunks, "
+             f"{s['files']} shard files, {s['bytes'] / 1e6:.2f} MB",
+             f"  meta: {', '.join(s['meta_keys']) or '(none)'}"]
+    for entry in m.leaves:
+        spec = "" if entry.spec is None else f"  spec={entry.spec}"
+        lines.append(f"  {entry.path}  {list(entry.shape)} {entry.dtype}"
+                     f"  x{len(entry.chunks)} chunk(s){spec}")
+    return "\n".join(lines)
+
+
+def diff_checkpoints(dir_a: str, dir_b: str) -> dict:
+    """Structural diff (leaves present, shape/dtype) plus max|a-b| for
+    leaves both checkpoints carry — a host-side tool, so full-leaf
+    assembly here is fine."""
+    ma, mb = read_manifest(dir_a), read_manifest(dir_b)
+    paths_a = {e.path: e for e in ma.leaves}
+    paths_b = {e.path: e for e in mb.leaves}
+    only_a = sorted(set(paths_a) - set(paths_b))
+    only_b = sorted(set(paths_b) - set(paths_a))
+    changed = []
+    max_abs_diff = 0.0
+    with _ChunkStore(dir_a) as sa, _ChunkStore(dir_b) as sb:
+        for path in sorted(set(paths_a) & set(paths_b)):
+            ea, eb = paths_a[path], paths_b[path]
+            if ea.shape != eb.shape or ea.dtype != eb.dtype:
+                changed.append({"path": path,
+                                "a": [list(ea.shape), ea.dtype],
+                                "b": [list(eb.shape), eb.dtype]})
+                continue
+            va = assemble_region(ea, sa, None, np.dtype(ea.dtype))
+            vb = assemble_region(eb, sb, None, np.dtype(eb.dtype))
+            d = float(np.max(np.abs(np.asarray(va, np.float64)
+                                    - np.asarray(vb, np.float64)))) \
+                if va.size else 0.0
+            max_abs_diff = max(max_abs_diff, d)
+            if d > 0.0:
+                changed.append({"path": path, "max_abs_diff": d})
+    return {
+        "a": {"dir": dir_a, "step": ma.step},
+        "b": {"dir": dir_b, "step": mb.step},
+        "only_in_a": only_a,
+        "only_in_b": only_b,
+        "changed": changed,
+        "max_abs_diff": max_abs_diff,
+        "identical": not (only_a or only_b or changed),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("ckpt", help="checkpoint root or step_* directory")
+    ap.add_argument("--verify", action="store_true",
+                    help="re-read every chunk and check CRC32s")
+    ap.add_argument("--diff", metavar="OTHER",
+                    help="compare against another checkpoint root/step dir")
+    ap.add_argument("--json", action="store_true",
+                    help="machine output instead of the table")
+    args = ap.parse_args(argv)
+    try:
+        step_dir = resolve_step_dir(args.ckpt)
+    except (FileNotFoundError, ValueError) as e:
+        print(str(e), file=sys.stderr)
+        return 2
+
+    if args.diff:
+        try:
+            other = resolve_step_dir(args.diff)
+        except (FileNotFoundError, ValueError) as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        result = diff_checkpoints(step_dir, other)
+        if args.json:
+            print(json.dumps(result, indent=1))
+        elif result["identical"]:
+            print(f"identical: {step_dir} == {other}")
+        else:
+            print(f"diff {step_dir} vs {other}:")
+            for path in result["only_in_a"]:
+                print(f"  only in A: {path}")
+            for path in result["only_in_b"]:
+                print(f"  only in B: {path}")
+            for c in result["changed"]:
+                print(f"  changed: {c}")
+        return 0 if result["identical"] else 1
+
+    if args.verify:
+        problems = verify_checksums(step_dir)
+        payload = {"dir": step_dir, "ok": not problems, "problems": problems}
+        if args.json:
+            print(json.dumps(payload, indent=1))
+        elif problems:
+            print(f"CORRUPT checkpoint {step_dir}:")
+            for p in problems:
+                print(f"  {p}")
+        else:
+            print(f"ok: every chunk of {step_dir} matches its manifest CRC")
+        return 0 if not problems else 1
+
+    if args.json:
+        print(json.dumps(summarize(step_dir), indent=1))
+    else:
+        print(format_summary(step_dir))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
