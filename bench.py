@@ -320,9 +320,171 @@ def measure(model: str = "mlp", precision: str = "fp32",
     return k * chunk * batch / max(t_med - fetch_lat, 0.2 * t_med)
 
 
+def measure_moe() -> float:
+    """A/B of the two MoE dispatch impls (parallel/moe.py) on a dp×ep mesh
+    at G ∈ {1, 4} experts per device: one MoE layer (router + grouped
+    expert FFNs, top-2, Switch aux) trained by a jitted SGD step, tokens/s
+    per config plus an analytic per-device comm-volume estimate in the
+    stage detail — the replicated path pays a dense (n_row, d) psum
+    allreduce regardless of expert occupancy, the alltoall path pays the
+    2×(E·C·d) capacity exchange. Headline value: alltoall tokens/s at G=4.
+
+    Same timing discipline as ``measure``: device-staged args, measured
+    fetch latency, run length doubled until a timed run dwarfs the tunnel
+    jitter, median of 3."""
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from deeplearning4j_tpu.parallel.moe import (
+        expected_dropped,
+        load_balance_loss,
+        moe_apply,
+        route_shards,
+    )
+
+    repeats = 3
+    if _fast():
+        d, dff, n_tokens = 32, 64, 512
+    else:
+        d, dff, n_tokens = 512, 1024, 16384
+
+    devs = jax.devices()
+    n_use = min(len(devs), 8)
+    ep = 2 if n_use >= 2 else 1
+    dp = max(n_use // ep, 1)
+    # top-2 is the flagship setting; a single-device run (ep=1, so the G=1
+    # config has exactly one expert) can only route top-1
+    top_k = 2 if ep >= 2 else 1
+    mesh = Mesh(np.array(devs[: dp * ep]).reshape(dp, ep),
+                ("data", "expert"))
+    n_row = n_tokens // dp
+
+    def expert_fn(p, t):
+        return jax.nn.relu(t @ p["w1"] + p["b1"]) @ p["w2"] + p["b2"]
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(jax.random.fold_in(key, 1), (n_tokens, d))
+    tgt = jnp.tanh(jax.random.normal(jax.random.fold_in(key, 2),
+                                     (n_tokens, d)))
+    zero = jnp.asarray(0)
+    float(jnp.sum(x) + jnp.sum(tgt) + zero)  # force + sync the transfers
+
+    fetch_lat = statistics.median(
+        _time_of(lambda: float(jnp.sum(zero + 1))) for _ in range(5)
+    )
+    target = 0.3 if _fast() else 1.2
+
+    def bench_config(group: int, impl: str) -> dict:
+        n_experts = group * ep
+        # equal-E, equal capacity-FACTOR A/B (GShard factor 1.25): capacity
+        # binds per (expert, sub-shard), so each impl gets the factor over
+        # ITS routing unit — the whole token row for replicated, one
+        # device's n_row/ep slice for alltoall. Same admitted global route
+        # budget either way; the buffers just live where the tokens do.
+        sub = n_row if impl == "replicated" else n_row // ep
+        capacity = max(-(-int(1.25 * top_k * sub) // n_experts), 1)
+        ks = jax.random.split(jax.random.fold_in(key, 10 + group), 2)
+        router_w = jax.random.normal(ks[0], (d, n_experts)) / (d ** 0.5)
+        ek = jax.random.split(ks[1], 4)
+        experts = {
+            "w1": jax.random.normal(ek[0], (n_experts, d, dff)) / (d ** 0.5),
+            "b1": jnp.zeros((n_experts, dff)),
+            "w2": jax.random.normal(ek[1], (n_experts, dff, d)) / (dff ** 0.5),
+            "b2": jnp.zeros((n_experts, d)),
+        }
+        from deeplearning4j_tpu.parallel.sharding import shard_leading_axis
+
+        experts = shard_leading_axis(experts, mesh, "expert")
+
+        # the hot loop only rebinds the (router, experts) state, so the old
+        # buffers donate into the update
+        @partial(jax.jit, donate_argnums=(0,))
+        def moe_step(state, xs, ys):
+            rw, ps = state
+
+            def loss_fn(rw, ps):
+                out = moe_apply(rw, ps, xs, mesh, expert_fn, capacity,
+                                top_k=top_k, token_axes=("data",), impl=impl)
+                task = jnp.mean((out - ys) ** 2)
+                return task + 1e-2 * load_balance_loss(rw, xs)
+
+            loss, (gr, ge) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1))(rw, ps)
+            new = (rw - 0.1 * gr,
+                   jax.tree_util.tree_map(lambda p, g: p - 0.1 * g, ps, ge))
+            return new, loss
+
+        # drop stats on the INITIAL router (donation below retires the
+        # original buffers; the init-time routing is the comparable stat)
+        n_shards = route_shards(mesh, ("data",), "expert", n_tokens, impl)
+        drop = expected_dropped(router_w, x, capacity, top_k,
+                                n_shards=n_shards)
+
+        state = (router_w, experts)
+        for _ in range(2):  # compile + committed-sharding warmup
+            state, loss = moe_step(state, x, tgt)
+        float(loss)
+
+        def run(k):
+            nonlocal state
+            t0 = time.perf_counter()
+            for _ in range(k):
+                state, loss = moe_step(state, x, tgt)
+            last = float(loss)  # true sync: device->host fetch
+            assert math.isfinite(last), "non-finite moe loss"
+            return time.perf_counter() - t0
+
+        k, t = 1, run(1)
+        while t < target + fetch_lat and k < 256:
+            k *= 2
+            t = run(k)
+        t_med = statistics.median([t] + [run(k) for _ in range(repeats - 1)])
+        rate = k * n_tokens / max(t_med - fetch_lat, 0.2 * t_med)
+
+        # analytic per-device FORWARD comm volume (backward transposes
+        # mirror it); f32 = 4 bytes, ring-allreduce convention for psum
+        if impl == "replicated":
+            comm = 2 * (ep - 1) / ep * n_row * d * 4
+        else:
+            comm = 2 * (ep - 1) / ep * n_experts * capacity * d * 4
+        return {
+            "n_experts": n_experts,
+            "capacity": capacity,
+            "tokens_per_sec": round(rate, 1),
+            "est_fwd_comm_bytes_per_dev": int(comm),
+            "dropped_frac": round(drop / (n_tokens * top_k), 4),
+        }
+
+    detail = {
+        "mesh": {"data": dp, "expert": ep},
+        "d_model": d, "d_ff": dff, "tokens_per_step": n_tokens,
+        "top_k": top_k,
+        "comm_model": (
+            "est_fwd_comm_bytes_per_dev: replicated = ring-allreduce of the "
+            "dense (n_row, d) combine, 2(p-1)/p·n_row·d·4; alltoall = "
+            "dispatch+return capacity exchange, 2(p-1)/p·E·C·d·4 — forward "
+            "only, the backward transposes mirror the same volumes"
+        ),
+    }
+    for group in (1, 4):
+        for impl in ("alltoall", "replicated"):
+            detail[f"{impl}_g{group}"] = bench_config(group, impl)
+    for group in (1, 4):
+        a2a = detail[f"alltoall_g{group}"]["tokens_per_sec"]
+        rep = detail[f"replicated_g{group}"]["tokens_per_sec"]
+        if rep:
+            detail[f"alltoall_vs_replicated_g{group}"] = round(a2a / rep, 2)
+    print("STAGE_DETAIL " + json.dumps(detail), flush=True)
+    return detail["alltoall_g4"]["tokens_per_sec"]
+
+
 def measure_word2vec(n_sentences: int = 2000, sent_len: int = 100,
                      vocab: int = 5000, layer_size: int = 100,
-                     batch_size: int = 8192) -> float:
+                     batch_size: int = 8192, mesh=None) -> float:
     """End-to-end Word2Vec skip-gram words/sec (BASELINE config #4): host
     tokenization + vectorized pair generation + device SGNS steps. Counted in
     corpus words per second, the reference's unit (Word2Vec.java:303-342).
@@ -330,7 +492,12 @@ def measure_word2vec(n_sentences: int = 2000, sent_len: int = 100,
     Two scales: the r01-r04 toy stage (V=5k, D=100, 200k words — small
     enough that post-round-5 the epoch is dispatch-latency-bound on BOTH
     platforms) and the `_large` stage (V=50k, D=256, 2M words) where
-    compute dominates and the chip's advantage is visible."""
+    compute dominates and the chip's advantage is visible.
+
+    ``mesh``: a data-parallel mesh routes training through
+    ``make_sharded_sgns_step`` (pair batches sharded over the data axis,
+    in-graph psum over ICI) — the `word2vec_sharded` stage, the next lever
+    the r05 bench note called out after the single-chip row-op work."""
     import numpy as np
 
     from deeplearning4j_tpu.models.word2vec import Word2Vec
@@ -348,7 +515,7 @@ def measure_word2vec(n_sentences: int = 2000, sent_len: int = 100,
     vec = Word2Vec(
         sentence_iterator=CollectionSentenceIterator(sents),
         layer_size=layer_size, window=5, negative=5, iterations=1,
-        sample=1e-3, batch_size=batch_size, seed=1,
+        sample=1e-3, batch_size=batch_size, seed=1, mesh=mesh,
     )
     vec.build_vocab()
     vec.fit()  # warmup: compiles the scan program (~25 s, one-time)
@@ -717,10 +884,21 @@ def run_stage(name: str) -> float:
             telemetry=not name.endswith("_densecore"))
     if name == "ckpt":
         return measure_ckpt()
+    if name == "moe":
+        return measure_moe()
     if name == "word2vec":
         if _fast():
             return measure_word2vec(n_sentences=100, sent_len=20, vocab=200)
         return measure_word2vec()
+    if name == "word2vec_sharded":
+        from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
+        import jax
+
+        mesh = data_parallel_mesh(min(len(jax.devices()), 8))
+        if _fast():
+            return measure_word2vec(n_sentences=100, sent_len=20, vocab=200,
+                                    mesh=mesh)
+        return measure_word2vec(mesh=mesh)
     if name == "word2vec_large":
         if _fast():
             return measure_word2vec(n_sentences=200, sent_len=20, vocab=500,
@@ -798,8 +976,10 @@ STAGES = [
     ("lm_composed", 280),
     ("lm_composed_densecore", 240),
     ("ckpt", 150),
+    ("moe", 220),
     ("cpu_word2vec", 150),
     ("word2vec", 120),
+    ("word2vec_sharded", 150),
     ("cpu_word2vec_large", 300),
     ("word2vec_large", 200),
 ]
@@ -865,6 +1045,8 @@ def main() -> None:
             key = f"{stage}_words_per_sec"
         elif stage == "ckpt":
             key = f"{stage}_save_mb_per_sec"
+        elif stage == "moe":
+            key = f"{stage}_tokens_per_sec"
         else:
             key = f"{stage}_samples_per_sec"
         remaining = deadline - time.monotonic()
@@ -902,6 +1084,9 @@ def main() -> None:
     w2vl_cpu = detail.get("cpu_word2vec_large_words_per_sec")
     if w2vl_tpu and w2vl_cpu:
         detail["word2vec_large_vs_cpu"] = round(w2vl_tpu / w2vl_cpu, 2)
+    w2vs = detail.get("word2vec_sharded_words_per_sec")
+    if w2vs and w2v_tpu:
+        detail["word2vec_sharded_vs_single"] = round(w2vs / w2v_tpu, 2)
     lmc = detail.get("lm_composed_samples_per_sec")
     lmc_dense = detail.get("lm_composed_densecore_samples_per_sec")
     if lmc and lmc_dense:
@@ -918,6 +1103,25 @@ def main() -> None:
         "cpu_lm_composed is the same blockwise stage in a forced-CPU "
         "child (batch=1). MFU is vs the fp32-DEFAULT peak; dense_moe "
         "executes all E experts per token and the FLOP model counts that."
+    )
+    detail["moe_note"] = (
+        "moe = one grouped MoE layer (top-2 router + E expert FFNs, "
+        "E = G x expert-axis size) trained on a dp×ep mesh, A/B-ing the "
+        "two dispatch impls (parallel/moe.py): alltoall = GShard capacity "
+        "exchange (tokens sharded over the expert axis too, comm "
+        "proportional to E·C·d), replicated = replicated-token compute + "
+        "dense psum combine (comm O(n_row·d) regardless of occupancy). "
+        "Value is alltoall tokens/s at G=4; the detail blob carries every "
+        "(impl, G) config's tokens/s, estimated per-device comm bytes, "
+        "capacity, and measured drop fraction."
+    )
+    detail["word2vec_sharded_note"] = (
+        "word2vec_sharded = the toy word2vec stage driven through "
+        "make_sharded_sgns_step on the data-parallel mesh (pair batches "
+        "sharded over the data axis, one in-graph psum per step over ICI) "
+        "— the next lever the r05 word2vec note called out; "
+        "word2vec_sharded_vs_single compares it to the single-chip "
+        "device-epoch stage at the same corpus."
     )
     detail["ckpt_note"] = (
         "ckpt = sharded save/restore (scaleout/ckpt) of the composed-LM "
@@ -964,6 +1168,12 @@ if __name__ == "__main__":
             import jax
 
             jax.config.update("jax_platforms", "cpu")
+            if sys.argv[2] in ("moe", "word2vec_sharded"):
+                # mesh stages need multiple devices; fake 8 CPU devices
+                # BEFORE first backend use (same trick as tests/conftest)
+                from deeplearning4j_tpu.compat import set_host_device_count
+
+                set_host_device_count(8)
         if sys.argv[2].endswith("_fp32_true"):
             import jax
 

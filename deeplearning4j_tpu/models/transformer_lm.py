@@ -52,8 +52,10 @@ from deeplearning4j_tpu.ops.flash_attention import attention_core
 from deeplearning4j_tpu.parallel.moe import (
     EXPERT_AXIS,
     _routing,
+    dropped_route_fraction,
     load_balance_loss,
     moe_apply,
+    route_shards,
     router_load_fraction,
 )
 from deeplearning4j_tpu.parallel.ring_attention import ring_attention
@@ -188,14 +190,17 @@ def lm_loss(params: dict, tokens: Array, targets: Array, n_heads: int,
 
 def lm_loss_and_metrics(params: dict, tokens: Array, targets: Array,
                         n_heads: int, attn_core, moe_fn,
-                        aux_weight: float = 1e-2, top_k: int = 2) -> tuple:
+                        aux_weight: float = 1e-2, top_k: int = 2,
+                        moe_drop_fn=None) -> tuple:
     """``lm_loss`` with an in-graph metrics aux: (loss, metrics).
 
     The loss is computed by the IDENTICAL op sequence as ``lm_loss`` (bit
     parity with the unthreaded step is pinned at 0 ulp in
     tests/test_telemetry.py); the metrics dict only adds reads of
-    intermediates the graph already has — task/aux split and the per-expert
-    router-load fraction (mean over layers; sums to 1 per step)."""
+    intermediates the graph already has — task/aux split, the per-expert
+    router-load fraction (mean over layers; sums to 1 per step), and — when
+    the builder passes ``moe_drop_fn(router_w, moe_in)`` (the composed
+    capacity paths do) — the capacity-overflow share ``moe_dropped_frac``."""
     logits, moe_ins = lm_forward(params, tokens, n_heads, attn_core, moe_fn)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
@@ -211,6 +216,9 @@ def lm_loss_and_metrics(params: dict, tokens: Array, targets: Array,
         "aux_loss": aux,
         "router_load": load,
     }
+    if moe_drop_fn is not None:
+        metrics["moe_dropped_frac"] = jnp.mean(
+            jax.vmap(moe_drop_fn)(params["blocks"]["router"], moe_ins))
     return loss, metrics
 
 
@@ -221,6 +229,25 @@ def selected_attn_impl(seq_len: int, attn_impl: Optional[str] = None) -> str:
     from deeplearning4j_tpu.ops.flash_attention import resolve_attention_impl
 
     return attn_impl or resolve_attention_impl(seq_len)
+
+
+def selected_moe_impl(mesh: Mesh, n_tokens: int,
+                      moe_impl: Optional[str] = None) -> Optional[str]:
+    """The MoE dispatch a composed step with this token count will run —
+    per-call arg > set_moe_impl/env override > auto divisibility gate.
+    Host-side static metadata (bench detail, telemetry run info); None on
+    meshes without an expert axis (dense MoE)."""
+    from deeplearning4j_tpu.parallel.moe import resolve_moe_impl
+
+    names = mesh.axis_names
+    if EXPERT_AXIS not in names:
+        return None
+    token_axes = tuple(a for a in (DATA_AXIS, SEQ_AXIS) if a in names)
+    rows = 1
+    for a in token_axes:
+        rows *= mesh.shape[a]
+    return resolve_moe_impl(n_tokens, rows * mesh.shape[EXPERT_AXIS],
+                            moe_impl)
 
 
 # --------------------------------------------------------------- builders ----
@@ -249,17 +276,22 @@ def dense_loss_fn(n_heads: int, top_k: int = 2, aux_weight: float = 1e-2,
 def composed_loss_fn(mesh: Mesh, n_heads: int, capacity: int,
                      top_k: int = 2, aux_weight: float = 1e-2,
                      attn_impl: Optional[str] = None,
+                     moe_impl: Optional[str] = None,
                      with_metrics: bool = False):
     """Loss with the parallel strategies the mesh's axes call for:
     "data" → batch sharding (GSPMD), "sp" → ring attention over the
-    sequence, "expert" → expert-parallel MoE dispatch. Any subset works:
-    a ("data","expert") mesh composes dp×ep; ("data","sp","expert")
-    composes all three. ``attn_impl`` forces the attention core on BOTH
-    paths (the ring's per-rotated-block core and the unsharded core);
-    default None resolves via the flash_attention override/env/auto chain.
-    ``with_metrics`` returns the (loss, metrics) twin — the router-load
-    fraction is computed on the GLOBAL (GSPMD-sharded) activations, so it
-    reports the same global balance the dense oracle sees.
+    sequence, "expert" → expert-parallel MoE dispatch (grouped: any
+    ``n_experts`` that is a multiple of the expert-axis size — G experts
+    per device). Any subset works: a ("data","expert") mesh composes
+    dp×ep; ("data","sp","expert") composes all three. ``attn_impl`` forces
+    the attention core on BOTH paths (the ring's per-rotated-block core and
+    the unsharded core); ``moe_impl`` forces the MoE dispatch
+    ("alltoall" | "replicated"); both default to their override/env/auto
+    chains. ``with_metrics`` returns the (loss, metrics) twin — the
+    router-load fraction is computed on the GLOBAL (GSPMD-sharded)
+    activations, so it reports the same global balance the dense oracle
+    sees, and the capacity paths add ``moe_dropped_frac`` (the overflow
+    share under the resolved dispatch's sub-shard semantics).
     """
     names = mesh.axis_names
     if SEQ_AXIS in names:
@@ -270,17 +302,24 @@ def composed_loss_fn(mesh: Mesh, n_heads: int, capacity: int,
     else:
         attn_core_fn = lambda q, k, v: attention_core(  # noqa: E731
             q, k, v, causal=True, impl=attn_impl)
+    moe_drop_fn = None
     if EXPERT_AXIS in names:
         token_axes = tuple(a for a in (DATA_AXIS, SEQ_AXIS) if a in names)
         moe_fn = lambda rw, ex, x: moe_apply(  # noqa: E731
             rw, ex, x, mesh, expert_fn, capacity, top_k=top_k,
-            token_axes=token_axes)
+            token_axes=token_axes, impl=moe_impl)
+        if with_metrics:
+            moe_drop_fn = lambda rw, xin: dropped_route_fraction(  # noqa: E731
+                rw, xin, capacity, top_k,
+                n_shards=route_shards(mesh, token_axes, EXPERT_AXIS,
+                                      xin.shape[0], moe_impl))
     else:
         moe_fn = lambda rw, ex, x: dense_moe(rw, ex, x, top_k)  # noqa: E731
     if with_metrics:
         return partial(lm_loss_and_metrics, n_heads=n_heads,
                        attn_core=attn_core_fn, moe_fn=moe_fn,
-                       aux_weight=aux_weight, top_k=top_k)
+                       aux_weight=aux_weight, top_k=top_k,
+                       moe_drop_fn=moe_drop_fn)
     return partial(lm_loss, n_heads=n_heads, attn_core=attn_core_fn,
                    moe_fn=moe_fn, aux_weight=aux_weight)
 
@@ -289,11 +328,22 @@ def lm_param_shardings(params: dict, mesh: Mesh) -> dict:
     """Per-leaf NamedSharding pytree for the flagship params on ``mesh``:
     experts onto the expert axis (when present), everything else
     replicated. Block leaves carry a leading layer axis, so the expert dim
-    is axis 1 there. This is the placement map BOTH ``shard_lm_params``
-    (initial placement) and the checkpoint resharding loader
-    (``scaleout.ckpt.restore_sharded``) use, so a restore onto any mesh
-    lands exactly where a fresh init would."""
+    is axis 1 there; with grouped experts (E = G × expert-axis size) each
+    device's shard is its contiguous G-expert slab, and the GLOBAL layout
+    is G-invariant — a G=4 save restores onto a G=1 mesh (and vice versa)
+    purely by re-chunking, no reshape. This is the placement map BOTH
+    ``shard_lm_params`` (initial placement) and the checkpoint resharding
+    loader (``scaleout.ckpt.restore_sharded``) use, so a restore onto any
+    mesh lands exactly where a fresh init would."""
     names = mesh.axis_names
+    if EXPERT_AXIS in names:
+        n_experts = params["blocks"]["experts"]["w1"].shape[1]
+        ep = mesh.shape[EXPERT_AXIS]
+        if n_experts % ep:
+            raise ValueError(
+                f"{n_experts} experts do not shard over the {ep}-device "
+                f"{EXPERT_AXIS!r} axis — grouped layout needs "
+                "n_experts % axis size == 0")
     rep = NamedSharding(mesh, P())
     out = {k: rep for k in params if k != "blocks"}
     blocks = {k: rep for k in params["blocks"] if k != "experts"}
@@ -359,21 +409,23 @@ def make_composed_train_step(mesh: Mesh, n_heads: int, capacity: int,
                              lr: float = 0.1, top_k: int = 2,
                              aux_weight: float = 1e-2,
                              attn_impl: Optional[str] = None,
+                             moe_impl: Optional[str] = None,
                              with_metrics: bool = False,
                              donate: bool = False):
     """SGD step over the composed mesh: step(params, tokens, targets) ->
     (new_params, loss). Shard inputs with shard_lm_params/shard_lm_batch
     first; GSPMD + the shard_map transposes insert every collective
     (grad AllReduce over data/sp, expert-grad reduce over token axes,
-    K/V ppermute ring, MoE psum).
+    K/V ppermute ring, and the MoE combine — capacity all_to_all exchange
+    or dense psum per ``moe_impl``; see parallel/moe.py).
 
     ``with_metrics=True`` returns (new_params, loss, metrics) where metrics
     is an in-graph dict (loss, task/aux split, grad_norm, param_norm,
-    update_ratio, (E,) router_load summing to 1) of DEVICE scalars — feed
-    it to telemetry.TrainTelemetry.record, which fetches every N steps so
-    the hot path stays one dispatch."""
+    update_ratio, (E,) router_load summing to 1, moe_dropped_frac) of
+    DEVICE scalars — feed it to telemetry.TrainTelemetry.record, which
+    fetches every N steps so the hot path stays one dispatch."""
     loss_fn = composed_loss_fn(mesh, n_heads, capacity, top_k, aux_weight,
-                               attn_impl=attn_impl,
+                               attn_impl=attn_impl, moe_impl=moe_impl,
                                with_metrics=with_metrics)
     return _make_sgd_step(loss_fn, lr, with_metrics, donate=donate)
 
@@ -395,7 +447,8 @@ def make_single_device_train_step(n_heads: int, lr: float = 0.1,
 # ----------------------------------------------------------------- dp×pp ----
 
 def make_pp_stages(params: dict, n_heads: int, n_stages: int = 2,
-                   top_k: int = 2, attn_impl: Optional[str] = None):
+                   top_k: int = 2, attn_impl: Optional[str] = None,
+                   moe_fn=None):
     """Split the decoder stack at LAYER BOUNDARIES into ``n_stages``
     pipeline stages — stage i owns layers [i·L/S, (i+1)·L/S) and applies
     them with a local ``lax.scan`` (dense experts: the pipe axis shards
@@ -411,7 +464,12 @@ def make_pp_stages(params: dict, n_heads: int, n_stages: int = 2,
 
     ``attn_impl`` forces the attention core of every staged layer; default
     None resolves via the flash_attention override/env/auto chain on the
-    microbatch sequence length."""
+    microbatch sequence length. ``moe_fn(router_w, experts, flat)``
+    overrides the staged FFN (default: the dense top-k MoE — the pipe axis
+    shards STAGES, so experts run dense inside each stage regardless of E;
+    grouped n_experts > n_devices rides along for free). The seam exists so
+    a capacity-matched dense twin (or a future ep-composed dispatch) can be
+    staged without re-deriving the stage math."""
     blocks = params["blocks"]
     n_layers = lm_n_layers(params)
     if n_layers % n_stages:
@@ -426,7 +484,7 @@ def make_pp_stages(params: dict, n_heads: int, n_stages: int = 2,
 
     core = lambda q, k, v: attention_core(q, k, v, causal=True,  # noqa: E731
                                           impl=attn_impl)
-    moe = lambda rw, ex, x: dense_moe(rw, ex, x, top_k)  # noqa: E731
+    moe = moe_fn or (lambda rw, ex, x: dense_moe(rw, ex, x, top_k))
 
     def stage_fn(p, x):
         def step(h, layer_params):

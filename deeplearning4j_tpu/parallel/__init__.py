@@ -1,2 +1,8 @@
 from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh, mesh_2d  # noqa: F401
+from deeplearning4j_tpu.parallel.moe import (  # noqa: F401
+    get_moe_impl,
+    moe_apply,
+    resolve_moe_impl,
+    set_moe_impl,
+)
 from deeplearning4j_tpu.parallel.trainer import ParameterAveragingTrainer  # noqa: F401

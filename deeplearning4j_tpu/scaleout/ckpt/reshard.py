@@ -8,6 +8,15 @@ offsets (``jax.make_array_from_callback``) — the full global array is never
 materialized on one host unless the caller asks for an unsharded restore
 (``shardings=None`` for that leaf).
 
+Grouped-expert resharding rides the same mechanism: the flagship keeps the
+GLOBAL expert layout G-invariant ((L, E, ...) leaves, device d owning the
+contiguous G-expert slab — models/transformer_lm.lm_param_shardings), so a
+G=4 save (chunks 4 experts wide) restores onto a G=1 mesh by SPLITTING
+inside each chunk, and a G=1 save restores onto a wider grouping by
+MERGING adjacent per-expert chunks — both are ordinary rectangle
+intersections here, pinned end to end in
+tests/test_ckpt_resume.py::test_grouped_expert_cross_g_resume.
+
 Strictness (no silent corruption): a missing leaf, a shape mismatch, a
 lossy dtype narrowing, or an uncovered target region all raise — nothing is
 broadcast, truncated, or ``astype``-narrowed on the way in.
@@ -120,6 +129,13 @@ def assemble_region(entry: LeafEntry, store: _ChunkStore, index,
     overlap volumes must sum to the region volume — anything less means a
     corrupt/incomplete checkpoint and raises."""
     starts, sizes = _region_of(index, entry.shape)
+    # same-layout fast path: when one saved chunk IS the requested region
+    # (same-mesh resume, the common case), hand its array back without the
+    # empty-alloc + copy — the resharding assembly below is only paid when
+    # the chunking actually changed (e.g. a cross-G expert regroup)
+    for chunk in entry.chunks:
+        if tuple(chunk.start) == starts and tuple(chunk.shape) == tuple(sizes):
+            return np.asarray(store.get(chunk.file, chunk.key), dtype=dtype)
     out = np.empty(sizes, dtype=dtype)
     covered = 0
     for chunk in entry.chunks:

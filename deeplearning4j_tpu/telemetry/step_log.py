@@ -107,7 +107,8 @@ def summarize_step_log(records: List[Dict]) -> Dict:
     tps = series("tokens_per_sec")
     if tps:
         out["tokens_per_sec_mean"] = round(statistics.fmean(tps), 1)
-    for key in ("loss", "score", "grad_norm", "param_norm", "update_ratio"):
+    for key in ("loss", "score", "grad_norm", "param_norm", "update_ratio",
+                "moe_dropped_frac"):
         vals = series(key)
         if vals:
             out[key] = {"first": round(vals[0], 6), "last": round(vals[-1], 6)}

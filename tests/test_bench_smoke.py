@@ -116,6 +116,46 @@ def test_bench_ckpt_stage_on_cpu():
     assert stage_detail.get("restore_mb_per_sec", 0) > 0
 
 
+def test_bench_moe_and_word2vec_sharded_stages_on_cpu():
+    """The grouped-MoE dispatch A/B stage and the mesh-sharded word2vec
+    stage run end to end on the CPU backend (8 faked devices): the moe
+    detail blob carries every (impl, G) config with tokens/s + estimated
+    comm bytes + capacity + drop fraction and the A/B ratios, and the
+    sharded word2vec stage lands a words/s number — tier-1 guards the new
+    stage plumbing without a chip."""
+    env = dict(os.environ)
+    env["BENCH_FORCE_CPU"] = "1"
+    env["BENCH_FAST"] = "1"
+    env["BENCH_BUDGET_SEC"] = "240"
+    env["BENCH_ONLY"] = "moe,word2vec_sharded"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=300, cwd=REPO, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    det = json.loads(out.stdout.strip().splitlines()[-1])["detail"]
+    assert det.get("moe_tokens_per_sec"), det.get("moe_status")
+    blob = det.get("moe_detail", {})
+    assert blob.get("mesh", {}).get("expert", 0) >= 2
+    assert blob.get("top_k") == 2
+    for group in (1, 4):
+        for impl in ("alltoall", "replicated"):
+            cfg = blob.get(f"{impl}_g{group}", {})
+            assert cfg.get("tokens_per_sec", 0) > 0, (impl, group, blob)
+            assert cfg.get("est_fwd_comm_bytes_per_dev", 0) > 0
+            assert cfg.get("capacity", 0) > 0
+            assert cfg.get("dropped_frac") is not None
+        # G experts per device actually materialized: E = G × ep
+        assert blob[f"alltoall_g{group}"]["n_experts"] == group * \
+            blob["mesh"]["expert"]
+        assert f"alltoall_vs_replicated_g{group}" in blob
+    assert "comm_model" in blob
+    # the headline value is the alltoall G=4 rate
+    assert det["moe_tokens_per_sec"] == blob["alltoall_g4"]["tokens_per_sec"]
+    assert det.get("word2vec_sharded_words_per_sec"), det.get(
+        "word2vec_sharded_status")
+
+
 def test_bench_skips_stages_past_deadline():
     env = dict(os.environ)
     env["BENCH_FORCE_CPU"] = "1"

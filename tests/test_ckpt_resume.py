@@ -218,6 +218,86 @@ def test_dp_ep_save_resumes_on_single_device(tmp_path):
     _assert_close(resumed, ref, "dp×ep → single-device resume params")
 
 
+def test_grouped_expert_cross_g_resume(tmp_path):
+    """Grouped-expert resharding chain: a dp2×ep2 run (n_experts=8, G=4,
+    all_to_all dispatch) checkpoints at step 3; the save's 4-expert-wide
+    chunks are SPLIT onto a dp1×ep8 mesh (G=1, per-expert shards), trained
+    3 more steps, saved again; those 1-expert chunks are MERGED back into
+    an unsharded single-device restore for the final 3 dense steps. The
+    uninterrupted twin does the identical mesh hand-offs in memory, so any
+    divergence is checkpoint/reshard infidelity — the global (L, E, ...)
+    expert layout is G-invariant and restores land where a fresh init
+    would (lm_param_shardings)."""
+    n_experts, n_layers = 8, 2
+    mesh_g4 = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                   ("data", "expert"))
+    mesh_g1 = Mesh(np.array(jax.devices()[:8]).reshape(1, 8),
+                   ("data", "expert"))
+
+    def composed_run(params, mesh, capacity, start, n, losses):
+        step = make_composed_train_step(mesh, H, capacity,
+                                        moe_impl="alltoall")
+        for i in range(start, start + n):
+            tk, tg = shard_lm_batch(*_step_data(i), mesh)
+            params, loss = step(params, tk, tg)
+            jax.block_until_ready(loss)
+            losses.append(float(loss))
+        return params
+
+    def dense_run(params, start, n, losses):
+        step = make_single_device_train_step(H)
+        for i in range(start, start + n):
+            tk, tg = _step_data(i)
+            params, loss = step(params, tk, tg)
+            losses.append(float(loss))
+        return params
+
+    cap_g4 = (B // 2) * T   # ample per token row on dp2
+    cap_g1 = B * T          # ample on the single dp row
+
+    def fresh():
+        return _params(n_experts=n_experts, n_layers=n_layers)
+
+    # uninterrupted twin: same hand-offs, no disk
+    ref_losses = []
+    p = composed_run(shard_lm_params(fresh(), mesh_g4), mesh_g4, cap_g4,
+                     0, 3, ref_losses)
+    p = composed_run(shard_lm_params(
+        jax.tree_util.tree_map(jnp.asarray, jax.device_get(p)), mesh_g1),
+        mesh_g1, cap_g1, 3, 3, ref_losses)
+    ref = dense_run(jax.tree_util.tree_map(jnp.asarray, jax.device_get(p)),
+                    6, 3, ref_losses)
+
+    # checkpointed chain: G=4 save → G=1 restore+save → unsharded restore
+    ck = _ck(tmp_path)
+    res_losses = []
+    q = composed_run(shard_lm_params(fresh(), mesh_g4), mesh_g4, cap_g4,
+                     0, 3, res_losses)
+    ck.save(3, {"params": q}, mesh=mesh_g4)
+    del q
+
+    template = {"params": fresh()}
+    shardings = {"params": lm_param_shardings(template["params"], mesh_g1)}
+    state, step_no, _ = ck.restore(template, shardings)
+    assert step_no == 3
+    w1 = state["params"]["blocks"]["experts"]["w1"]
+    assert w1.shape == (n_layers, n_experts, D, DFF)
+    # per-expert shards on the G=1 mesh (the split half of the round trip)
+    starts = {tuple(sl.indices(n_experts)[0] for sl in s.index[1:2])
+              for s in w1.addressable_shards}
+    assert len(starts) == 8 and w1.addressable_shards[0].data.shape[1] == 1
+    q = composed_run(state["params"], mesh_g1, cap_g1, 3, 3, res_losses)
+    ck.save(6, {"params": q}, mesh=mesh_g1)
+    del q
+
+    state, step_no, _ = ck.restore({"params": fresh()}, shardings=None)
+    assert step_no == 6
+    resumed = dense_run(state["params"], 6, 3, res_losses)
+
+    np.testing.assert_allclose(res_losses, ref_losses, atol=ATOL, rtol=0)
+    _assert_close(resumed, ref, "G=4 → G=1 → single-device resume params")
+
+
 # ------------------------------------------------------- trainer facade ----
 
 def _mlp_conf(num_iterations=1, dropout=0.0, seed=11):

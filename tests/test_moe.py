@@ -1,17 +1,25 @@
-"""Expert-parallel MoE tests (beyond-reference axis — completes dp/tp/sp/pp/ep)."""
+"""Expert-parallel MoE tests: grouped (G experts per device) capacity
+dispatch, BOTH impls (GShard all_to_all exchange and the replicated-psum
+path) pinned against shard-aware dense references — loss AND gradients."""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import Mesh
 
 from deeplearning4j_tpu.parallel.moe import (
     EXPERT_AXIS,
+    _routing,
+    dropped_route_fraction,
     expected_dropped,
     expert_load,
     load_balance_loss,
     moe_apply,
     moe_reference,
+    resolve_moe_impl,
+    route_shards,
+    set_moe_impl,
     shard_expert_params,
     stack_expert_params,
 )
@@ -21,97 +29,245 @@ N_EXPERTS = 8
 N_TOKENS = 64
 
 
-def _mesh():
-    return Mesh(np.array(jax.devices()[:N_EXPERTS]), (EXPERT_AXIS,))
+def _mesh(n_dev=N_EXPERTS):
+    return Mesh(np.array(jax.devices()[:n_dev]), (EXPERT_AXIS,))
 
 
 def _expert_fn(params, x):
     return jnp.tanh(x @ params["w"] + params["b"])
 
 
-def _setup(seed=0):
-    ks = jax.random.split(jax.random.PRNGKey(seed), N_EXPERTS + 2)
+def _setup(seed=0, n_experts=N_EXPERTS):
+    ks = jax.random.split(jax.random.PRNGKey(seed), n_experts + 2)
     per_expert = [
         {"w": jax.random.normal(k, (D, D)) / np.sqrt(D), "b": jnp.zeros((D,))}
-        for k in ks[:N_EXPERTS]
+        for k in ks[:n_experts]
     ]
-    router_w = jax.random.normal(ks[-2], (D, N_EXPERTS)) / np.sqrt(D)
+    router_w = jax.random.normal(ks[-2], (D, n_experts)) / np.sqrt(D)
     x = jax.random.normal(ks[-1], (N_TOKENS, D))
     return router_w, per_expert, x
 
 
-def _dense_jax(router_w, stacked, x, capacity):
+def _shards(mesh, impl):
+    return route_shards(mesh, (), EXPERT_AXIS, N_TOKENS, impl)
+
+
+def _dense_jax(router_w, stacked, x, capacity, top_k=1, n_shards=1):
     """Pure-JAX single-device replica of the sharded dispatch math (same
-    capacity/ordering semantics) — differentiable, for gradient parity."""
+    capacity/ordering semantics, per-sub-shard routing) — differentiable,
+    for gradient parity against EITHER impl (pass its route_shards)."""
     n = x.shape[0]
-    logits = x @ router_w
-    probs = jax.nn.softmax(logits, axis=-1)
-    assign = jnp.argmax(logits, axis=-1)
-    gate = jnp.take_along_axis(probs, assign[:, None], axis=1)[:, 0]
+    n_experts = router_w.shape[1]
+    per = n // n_shards
     out = jnp.zeros_like(x)
-    for e in range(N_EXPERTS):
-        mine = assign == e
-        order = jnp.argsort(jnp.where(mine, jnp.arange(n), n + jnp.arange(n)))
-        slots = order[:capacity]
-        valid = mine[slots]
-        params_e = jax.tree_util.tree_map(lambda a: a[e], stacked)
-        y = _expert_fn(params_e, x[slots] * valid[:, None])
-        out = out.at[slots].add(y * (gate[slots] * valid)[:, None])
+    for s in range(n_shards):
+        xs = x[s * per:(s + 1) * per]
+        idx, gates = _routing(xs @ router_w, top_k)
+        for e in range(n_experts):
+            mine_k = idx == e
+            mine = mine_k.any(-1)
+            gate = jnp.sum(gates * mine_k, axis=-1)
+            order = jnp.argsort(
+                jnp.where(mine, jnp.arange(per), per + jnp.arange(per)))
+            slots = order[:capacity]
+            valid = mine[slots]
+            params_e = jax.tree_util.tree_map(lambda a: a[e], stacked)
+            y = _expert_fn(params_e, xs[slots] * valid[:, None])
+            out = out.at[s * per + slots].add(
+                y * (gate[slots] * valid)[:, None])
     return out
 
 
-def test_moe_matches_dense_reference():
+@pytest.mark.parametrize("impl", ["replicated", "alltoall"])
+def test_moe_matches_dense_reference(impl):
     router_w, per_expert, x = _setup()
     mesh = _mesh()
     stacked = shard_expert_params(stack_expert_params(per_expert), mesh)
-    capacity = N_TOKENS  # ample: nothing dropped
-    out = moe_apply(router_w, stacked, x, mesh, _expert_fn, capacity)
-    ref = moe_reference(router_w, per_expert, x, _expert_fn, capacity)
+    capacity = N_TOKENS  # ample: nothing dropped on either dispatch
+    out = moe_apply(router_w, stacked, x, mesh, _expert_fn, capacity,
+                    impl=impl)
+    ref = moe_reference(router_w, per_expert, x, _expert_fn, capacity,
+                        n_token_shards=_shards(mesh, impl))
     assert jnp.allclose(out, ref, atol=1e-5), float(
         jnp.max(jnp.abs(out - ref)))
     assert expected_dropped(router_w, x, capacity) == 0
 
 
-def test_capacity_overflow_drops_tokens():
+@pytest.mark.parametrize("impl", ["replicated", "alltoall"])
+def test_capacity_overflow_drops_tokens(impl):
+    """Overflow semantics per impl: capacity binds per (expert, sub-shard)
+    — the whole replicated token row vs each alltoall source device — and
+    the shard-aware reference reproduces either exactly."""
     router_w, per_expert, x = _setup(1)
     mesh = _mesh()
     stacked = shard_expert_params(stack_expert_params(per_expert), mesh)
     capacity = 4  # 64 tokens / 8 experts: busy experts must overflow
-    dropped = expected_dropped(router_w, x, capacity)
+    n_shards = _shards(mesh, impl)
+    dropped = expected_dropped(router_w, x, capacity, n_shards=n_shards)
     assert dropped > 0
-    out = moe_apply(router_w, stacked, x, mesh, _expert_fn, capacity)
-    ref = moe_reference(router_w, per_expert, x, _expert_fn, capacity)
+    assert abs(float(dropped_route_fraction(
+        router_w, x, capacity, n_shards=n_shards)) - dropped / N_TOKENS) < 1e-6
+    out = moe_apply(router_w, stacked, x, mesh, _expert_fn, capacity,
+                    impl=impl)
+    ref = moe_reference(router_w, per_expert, x, _expert_fn, capacity,
+                        n_token_shards=n_shards)
     assert jnp.allclose(out, ref, atol=1e-5)
     # dropped tokens contribute exactly zero
     n_zero_rows = int(jnp.sum(jnp.all(out == 0, axis=-1)))
     assert n_zero_rows >= dropped
 
 
-def test_moe_gradients_match_dense():
-    """Gradients through the sharded dispatch (gather/scatter/psum) equal
-    the dense replica's for router AND expert params."""
-    router_w, per_expert, x = _setup(2)
-    mesh = _mesh()
-    stacked_sharded = shard_expert_params(stack_expert_params(per_expert), mesh)
-    stacked_local = stack_expert_params(per_expert)
+@pytest.mark.parametrize("group", [1, 2, 4])
+@pytest.mark.parametrize("top_k", [1, 2])
+def test_grouped_alltoall_matches_dense_with_grads(group, top_k):
+    """The tentpole parity matrix: grouped all_to_all dispatch
+    (n_experts = G × devices) vs the differentiable dense oracle with
+    IDENTICAL per-device capacity semantics — loss AND router/expert
+    gradients to 1e-5, at a capacity tight enough to force overflow
+    drops."""
+    n_dev = 4
+    mesh = _mesh(n_dev)
+    n_experts = group * n_dev
+    router_w, per_expert, x = _setup(seed=2 + group, n_experts=n_experts)
+    sharded = shard_expert_params(stack_expert_params(per_expert), mesh)
+    local = stack_expert_params(per_expert)
     tgt = jax.random.normal(jax.random.PRNGKey(9), (N_TOKENS, D))
-    capacity = 16
+    # n_local = 16 tokens/device: cap 3 overflows whenever >3 of a device's
+    # tokens pick one expert (guaranteed-ish at G=1: 16 tokens, 4 experts)
+    capacity = 3
+    n_shards = n_dev  # alltoall routes per device
 
     def sharded_loss(rw, params):
-        out = moe_apply(rw, params, x, mesh, _expert_fn, capacity)
-        return jnp.mean((out - tgt) ** 2)
+        out = moe_apply(rw, params, x, mesh, _expert_fn, capacity,
+                        top_k=top_k, impl="alltoall")
+        return jnp.mean((out - tgt) ** 2), out
 
     def dense_loss(rw, params):
-        out = _dense_jax(rw, params, x, capacity)
-        return jnp.mean((out - tgt) ** 2)
+        out = _dense_jax(rw, params, x, capacity, top_k, n_shards)
+        return jnp.mean((out - tgt) ** 2), out
 
-    gr_s, ge_s = jax.grad(sharded_loss, argnums=(0, 1))(router_w, stacked_sharded)
-    gr_d, ge_d = jax.grad(dense_loss, argnums=(0, 1))(router_w, stacked_local)
+    (ls, out_s), (gr_s, ge_s) = jax.value_and_grad(
+        sharded_loss, argnums=(0, 1), has_aux=True)(router_w, sharded)
+    (ld, out_d), (gr_d, ge_d) = jax.value_and_grad(
+        dense_loss, argnums=(0, 1), has_aux=True)(router_w, local)
+    assert abs(float(ls) - float(ld)) < 1e-5
+    assert jnp.allclose(out_s, out_d, atol=1e-5)
     assert jnp.allclose(gr_s, gr_d, atol=1e-5), float(
         jnp.max(jnp.abs(gr_s - gr_d)))
     for k in ("w", "b"):
         err = float(jnp.max(jnp.abs(jnp.asarray(ge_s[k]) - ge_d[k])))
         assert err < 1e-5, (k, err)
+
+
+def test_grouped_replicated_matches_dense():
+    """The generalized replicated path at G=2: per-row capacity semantics
+    with a local expert GROUP per device (vmap'd compute, one psum)."""
+    n_dev = 4
+    mesh = _mesh(n_dev)
+    router_w, per_expert, x = _setup(seed=6, n_experts=2 * n_dev)
+    stacked = shard_expert_params(stack_expert_params(per_expert), mesh)
+    for capacity in (N_TOKENS, 5):
+        out = moe_apply(router_w, stacked, x, mesh, _expert_fn, capacity,
+                        top_k=2, impl="replicated")
+        ref = moe_reference(router_w, per_expert, x, _expert_fn, capacity,
+                            top_k=2, n_token_shards=1)
+        assert jnp.allclose(out, ref, atol=1e-5), float(
+            jnp.max(jnp.abs(out - ref)))
+
+
+def test_moe_impl_seam_precedence(monkeypatch):
+    """per-call impl > set_moe_impl > DL4J_TPU_MOE_IMPL env > auto — the
+    same chain as the attention core seam. Observable discriminator: the
+    two impls drop DIFFERENT tokens at a tight capacity, so each resolved
+    impl is verified against its own reference."""
+    router_w, per_expert, x = _setup(1)
+    mesh = _mesh()
+    stacked = shard_expert_params(stack_expert_params(per_expert), mesh)
+    capacity = 4
+
+    def run(**kw):
+        return moe_apply(router_w, stacked, x, mesh, _expert_fn, capacity,
+                         **kw)
+
+    def ref(impl):
+        return moe_reference(router_w, per_expert, x, _expert_fn, capacity,
+                             n_token_shards=_shards(mesh, impl))
+
+    # the two semantics genuinely differ at this capacity (else no signal)
+    assert not jnp.allclose(ref("alltoall"), ref("replicated"), atol=1e-5)
+    # auto (divisible tokens) → alltoall
+    assert resolve_moe_impl(N_TOKENS, 8) == "alltoall"
+    assert jnp.allclose(run(), ref("alltoall"), atol=1e-5)
+    # env var outranks auto
+    monkeypatch.setenv("DL4J_TPU_MOE_IMPL", "replicated")
+    assert resolve_moe_impl(N_TOKENS, 8) == "replicated"
+    assert jnp.allclose(run(), ref("replicated"), atol=1e-5)
+    # setter outranks env
+    set_moe_impl("alltoall")
+    try:
+        assert resolve_moe_impl(N_TOKENS, 8) == "alltoall"
+        assert jnp.allclose(run(), ref("alltoall"), atol=1e-5)
+        # per-call outranks everything
+        assert jnp.allclose(run(impl="replicated"), ref("replicated"),
+                            atol=1e-5)
+    finally:
+        set_moe_impl(None)
+    monkeypatch.delenv("DL4J_TPU_MOE_IMPL")
+
+
+def test_moe_validation_errors():
+    router_w, per_expert, x = _setup()
+    mesh = _mesh()
+    stacked = shard_expert_params(stack_expert_params(per_expert), mesh)
+    # n_experts not a multiple of the axis size
+    with pytest.raises(ValueError, match="multiple"):
+        moe_apply(router_w[:, :6], stacked, x, mesh, _expert_fn, 8)
+    # forced alltoall on a token count that does not subdivide
+    with pytest.raises(ValueError, match="divide"):
+        moe_apply(router_w, stacked, x[:60], mesh, _expert_fn, 8,
+                  impl="alltoall")
+    # auto falls back to replicated on the same shape (60 % 8 != 0)
+    out = moe_apply(router_w, stacked, x[:60], mesh, _expert_fn, N_TOKENS)
+    ref = moe_reference(router_w, per_expert, x[:60], _expert_fn, N_TOKENS)
+    assert jnp.allclose(out, ref, atol=1e-5)
+
+
+def test_alltoall_step_retrace_budget(retrace_budget):
+    """A warmed jitted SGD step through the all_to_all dispatch holds a
+    0-compile steady budget — the exchange/scatter shapes are static, so
+    per-step retraces would be a regression."""
+    router_w, per_expert, x = _setup(7)
+    mesh = _mesh()
+    params = shard_expert_params(stack_expert_params(per_expert), mesh)
+    tgt = jnp.tanh(jax.random.normal(jax.random.PRNGKey(13), (N_TOKENS, D)))
+    # collective warmup: see the comment in test_moe_trains
+    jax.block_until_ready(
+        moe_apply(router_w, params, x, mesh, _expert_fn, 16,
+                  impl="alltoall"))
+
+    @jax.jit
+    def step(rw, ps):
+        def loss_fn(rw, ps):
+            out = moe_apply(rw, ps, x, mesh, _expert_fn, 16, top_k=2,
+                            impl="alltoall")
+            return jnp.mean((out - tgt) ** 2)
+
+        loss, (gr, ge) = jax.value_and_grad(loss_fn, argnums=(0, 1))(rw, ps)
+        return rw - 0.5 * gr, jax.tree_util.tree_map(
+            lambda p, g: p - 0.5 * g, ps, ge), loss
+
+    # two warm steps: the first compiles; the second compiles ONCE more
+    # against the committed shardings the first update's outputs carry
+    # (host-placed inputs became device-committed outputs — same warmup
+    # the dp×pp parity harness documents in test_composed.py)
+    for _ in range(2):
+        router_w, params, loss = step(router_w, params)
+        jax.block_until_ready(loss)
+    with retrace_budget(0, label="alltoall moe step steady state"):
+        for _ in range(2):
+            router_w, params, loss = step(router_w, params)
+            jax.block_until_ready(loss)
+    assert np.isfinite(float(loss))
 
 
 def test_moe_trains():
@@ -158,7 +314,8 @@ def test_moe_trains():
 
 def test_top2_matches_reference():
     """Top-2 dispatch parity: a token's two experts both contribute, gates
-    renormalized — sharded == dense reference, with and without overflow."""
+    renormalized — sharded == dense reference, with and without overflow
+    (auto resolves the impl; the reference follows its shard semantics)."""
     router_w, per_expert, x = _setup(4)
     mesh = _mesh()
     stacked = shard_expert_params(stack_expert_params(per_expert), mesh)
@@ -166,7 +323,7 @@ def test_top2_matches_reference():
         out = moe_apply(router_w, stacked, x, mesh, _expert_fn, capacity,
                         top_k=2)
         ref = moe_reference(router_w, per_expert, x, _expert_fn, capacity,
-                            top_k=2)
+                            top_k=2, n_token_shards=_shards(mesh, None))
         assert jnp.allclose(out, ref, atol=1e-5), float(
             jnp.max(jnp.abs(out - ref)))
     # with ample capacity every token got BOTH experts: no zero rows and

@@ -326,6 +326,33 @@ class TestComposedTelemetry:
             assert m["router_load"].shape == (2,)
             assert abs(float(m["router_load"].sum()) - 1.0) < 1e-5
             assert float(m["grad_norm"]) > 0
+            # capacity path threads the drop gauge; ample capacity → 0
+            assert float(m["moe_dropped_frac"]) == 0.0
+
+    def test_dropped_frac_metric_reports_overflow(self):
+        """A deliberately tight capacity surfaces a nonzero
+        moe_dropped_frac through the metrics-threaded composed step — the
+        in-graph twin of parallel.moe.expected_dropped."""
+        from deeplearning4j_tpu.models.transformer_lm import (
+            init_lm_params,
+            make_composed_train_step,
+            shard_lm_batch,
+            shard_lm_params,
+        )
+
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                    ("data", "expert"))
+        params = init_lm_params(jax.random.PRNGKey(0), V, D, H, 4, DFF,
+                                n_layers=1)
+        step = make_composed_train_step(mesh, H, capacity=2,
+                                        with_metrics=True)
+        tk, tg = _lm_data()
+        sp = shard_lm_params(params, mesh)
+        stk, stg = shard_lm_batch(tk, tg, mesh)
+        sp, loss, metrics = step(sp, stk, stg)
+        jax.block_until_ready(loss)
+        frac = float(jax.device_get(metrics)["moe_dropped_frac"])
+        assert 0.0 < frac < 1.0, frac
 
     def test_step_log_prometheus_and_memory_endpoints(self, tmp_path):
         """The acceptance run: dp×sp×ep train with telemetry produces a
