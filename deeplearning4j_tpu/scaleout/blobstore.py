@@ -44,6 +44,17 @@ class BlobStore:
     def get(self, key: str) -> bytes:
         raise NotImplementedError
 
+    def try_get(self, key: str) -> Optional[bytes]:
+        """``get`` or None if absent — one call, so a poller can't race a
+        concurrent delete between ``exists`` and ``get``. Backends whose
+        ``get`` raises ``KeyError``/``FileNotFoundError`` get this for
+        free; local puts are atomic renames, so a non-None result is
+        always a complete object."""
+        try:
+            return self.get(key)
+        except (KeyError, FileNotFoundError):
+            return None
+
     def exists(self, key: str) -> bool:
         raise NotImplementedError
 

@@ -36,9 +36,12 @@ from deeplearning4j_tpu.scaleout.ckpt.manifest import (  # noqa: F401
     step_dir_name,
 )
 from deeplearning4j_tpu.scaleout.ckpt.sharded_io import (  # noqa: F401
+    merge_process_manifests,
+    save_process_shards,
     save_sharded,
 )
 from deeplearning4j_tpu.scaleout.ckpt.reshard import (  # noqa: F401
+    CorruptShardError,
     latest_step,
     latest_step_dir,
     restore_sharded,
@@ -48,6 +51,9 @@ from deeplearning4j_tpu.scaleout.ckpt.checkpointer import (  # noqa: F401
     Checkpointer,
     CheckpointIterationListener,
     replicated_shardings,
+)
+from deeplearning4j_tpu.scaleout.ckpt.async_ckpt import (  # noqa: F401
+    AsyncCheckpointer,
 )
 from deeplearning4j_tpu.scaleout.ckpt.net_state import (  # noqa: F401
     capture_net_state,

@@ -1,0 +1,162 @@
+"""Background (off-the-training-thread) snapshots.
+
+A blocking ``Checkpointer.save`` fetches every shard to host and fsyncs the
+files on the training thread — at save steps the step time spikes by the
+full serialize+IO cost. ``AsyncCheckpointer`` moves that cost to a
+dedicated writer thread:
+
+- ``save(step, state)`` starts a non-blocking device→host copy for every
+  addressable shard (``copy_to_host_async``), enqueues the snapshot, and
+  returns immediately; the writer thread materializes the (by then mostly
+  landed) host bytes and runs the ordinary manifest-committed save.
+- The queue is double-buffered (``max_pending=2``): one snapshot draining,
+  one on deck. A third save while both buffers are full blocks — creating
+  checkpoints faster than the disk drains them should apply backpressure,
+  not grow memory without bound — and bumps ``<prefix>_async_backpressure``.
+- A failed background save can't be raised on the caller's stack, so it is
+  counted (``<prefix>_async_failures_total``), logged, kept as
+  ``last_error``, and re-raised at the next ``flush()``/``close()`` — a
+  run that checks its flush can never silently lose every snapshot.
+
+Caveat (same as any async snapshot scheme): the caller must not donate the
+saved arrays to the next step before the device→host copy completes. Pass
+a non-donating step's output, a host tree, or ``flush()`` first. The
+elastic master snapshots host-averaged numpy trees, which are trivially
+safe.
+
+Atomicity is inherited: the writer thread calls the same
+manifest-commit-last path, so a crash (or process exit with the daemon
+writer mid-save) leaves an invisible, GC-able directory — never a
+half-checkpoint a resume could pick up.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Dict, Optional
+
+log = logging.getLogger(__name__)
+
+_SENTINEL = object()
+
+
+def _start_host_copies(state) -> None:
+    """Kick off non-blocking device→host transfers for every leaf that
+    supports it, so the writer thread's ``np.asarray`` finds the bytes
+    already on host instead of synchronizing the device then."""
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(state):
+        copy = getattr(leaf, "copy_to_host_async", None)
+        if copy is not None:
+            try:
+                copy()
+            except Exception:  # non-committed/donated arrays: let the
+                pass           # writer thread surface the real error
+
+
+class AsyncCheckpointer:
+    """A ``Checkpointer`` facade whose saves run on a background writer
+    thread. Restore/latest/gc and friends delegate to the wrapped
+    checkpointer (flushing pending saves first where staleness would
+    surprise: a restore right after a save must see that save)."""
+
+    def __init__(self, checkpointer, max_pending: int = 2):
+        self._ck = checkpointer
+        self.registry = checkpointer.registry
+        self.prefix = checkpointer.prefix
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, max_pending))
+        self._error_lock = threading.Lock()
+        self.last_error: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._writer_loop,
+                                        daemon=True, name="ckpt-writer")
+        self._thread.start()
+
+    # ---------------------------------------------------------- writes ----
+    def save(self, step: int, state, meta: Optional[Dict] = None,
+             mesh=None) -> None:
+        """Enqueue a snapshot and return (no step-dir yet — the commit
+        happens on the writer thread). Blocks only when both snapshot
+        buffers are full."""
+        reg, p = self.registry, self.prefix
+        _start_host_copies(state)
+        item = (int(step), state, dict(meta or {}), mesh)
+        if self._queue.full():
+            reg.counter(f"{p}_async_backpressure").inc()
+        self._queue.put(item)
+        reg.gauge(f"{p}_async_pending").set(float(self._queue.qsize()))
+
+    save_async = save
+
+    def maybe_save(self, step, state_fn, save_every, meta=None, mesh=None):
+        if save_every <= 0 or step <= 0 or step % save_every:
+            return None
+        return self.save(step, state_fn(), meta=meta, mesh=mesh)
+
+    def _writer_loop(self) -> None:
+        reg, p = self.registry, self.prefix
+        while True:
+            item = self._queue.get()
+            if item is _SENTINEL:
+                self._queue.task_done()
+                return
+            step, state, meta, mesh = item
+            try:
+                self._ck.save(step, state, meta=meta, mesh=mesh)
+                reg.counter(f"{p}_async_saves_total").inc()
+            except BaseException as exc:  # surfaced at flush()/close()
+                with self._error_lock:
+                    self.last_error = exc
+                reg.counter(f"{p}_async_failures_total").inc()
+                log.exception("background checkpoint save for step %s "
+                              "failed", step)
+            finally:
+                self._queue.task_done()
+                reg.gauge(f"{p}_async_pending").set(
+                    float(self._queue.qsize()))
+
+    # ----------------------------------------------------------- sync ----
+    def flush(self) -> None:
+        """Block until every enqueued save has committed; re-raise the
+        first background failure since the last flush."""
+        self._queue.join()
+        with self._error_lock:
+            exc, self.last_error = self.last_error, None
+        if exc is not None:
+            raise exc
+
+    def close(self) -> None:
+        self.flush()
+        self._queue.put(_SENTINEL)
+        self._thread.join(timeout=30)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------- delegates ----
+    def restore(self, template, shardings=None, step=None):
+        self.flush()  # a restore must see the saves issued before it
+        return self._ck.restore(template, shardings=shardings, step=step)
+
+    def restore_net(self, step=None):
+        self.flush()
+        return self._ck.restore_net(step=step)
+
+    def latest_step(self):
+        self.flush()
+        return self._ck.latest_step()
+
+    def step_dirs(self):
+        return self._ck.step_dirs()
+
+    def gc(self) -> None:
+        self._ck.gc()
+
+    @property
+    def root(self) -> str:
+        return self._ck.root

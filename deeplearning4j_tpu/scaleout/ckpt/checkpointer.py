@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import logging
 import shutil
+import threading
 import time
 from typing import Callable, Dict, Optional, Tuple
 
@@ -59,6 +60,12 @@ class Checkpointer:
         self.registry = registry if registry is not None else default_registry()
         self.prefix = prefix
         self.verify_on_restore = verify_on_restore
+        # retention-race pin: the step a reader most recently resolved
+        # (latest_step()/restore()) is never GC'd, even if newer saves
+        # (possibly from a background writer thread) push it past
+        # keep_last mid-restore
+        self._pin_lock = threading.Lock()
+        self._last_resolved_step: Optional[int] = None
 
     # ------------------------------------------------------------- save ----
     def save(self, step: int, state, meta: Optional[Dict] = None,
@@ -87,11 +94,57 @@ class Checkpointer:
             return None
         return self.save(step, state_fn(), meta=meta, mesh=mesh)
 
+    # ------------------------------------------------- multi-host save ----
+    def save_process(self, step: int, state,
+                     process_index: Optional[int] = None) -> str:
+        """Every host's half of a multi-host save: write only this
+        process's addressable chunks + an atomic part manifest. Commit
+        happens on the coordinator via ``merge_save``; until then the step
+        is invisible to every reader."""
+        from deeplearning4j_tpu.scaleout.ckpt.sharded_io import (
+            save_process_shards,
+        )
+
+        return save_process_shards(self.root, step, state,
+                                   process_index=process_index)
+
+    def merge_save(self, step: int, n_processes: int,
+                   meta: Optional[Dict] = None, mesh=None, state=None,
+                   timeout_s: float = 120.0) -> str:
+        """Coordinator-only: the manifest merge barrier (waits for all
+        ``n_processes`` part manifests, validates coverage, commits LAST)
+        plus the same telemetry + retention a single-host ``save`` gets."""
+        from deeplearning4j_tpu.scaleout.ckpt.sharded_io import (
+            merge_process_manifests,
+        )
+
+        reg, p = self.registry, self.prefix
+        t0 = time.perf_counter()
+        step_dir = merge_process_manifests(
+            self.root, step, n_processes, meta=meta, mesh=mesh, state=state,
+            timeout_s=timeout_s)
+        # graftlint: allow[untimed-dispatch] merge is pure host IO (part-manifest JSON + rename); nothing device-side is in flight
+        merge_ms = (time.perf_counter() - t0) * 1000.0
+        manifest = mf.read_manifest(step_dir)
+        reg.counter(f"{p}_saves_total").inc()
+        reg.counter(f"{p}_bytes_total").inc(float(manifest.total_bytes))
+        reg.histogram(f"{p}_save_ms").observe(merge_ms)
+        reg.gauge(f"{p}_last_step").set(float(step))
+        self.gc()
+        return step_dir
+
     # ---------------------------------------------------------- restore ----
     def latest_step(self) -> Optional[int]:
         from deeplearning4j_tpu.scaleout.ckpt.reshard import latest_step
 
-        return latest_step(self.root)
+        step = latest_step(self.root)
+        if step is not None:
+            self._pin(step)
+        return step
+
+    def _pin(self, step: int) -> None:
+        with self._pin_lock:
+            self._last_resolved_step = int(step)
 
     def step_dirs(self):
         return mf.committed_steps(self.root)
@@ -102,6 +155,9 @@ class Checkpointer:
             if step_dir is None:
                 raise FileNotFoundError(
                     f"no committed checkpoint under {self.root}")
+            resolved = mf.parse_step(step_dir)
+            if resolved is not None:
+                self._pin(resolved)
             return step_dir
         import os
 
@@ -109,6 +165,7 @@ class Checkpointer:
         if not mf.has_manifest(step_dir):
             raise FileNotFoundError(
                 f"step {step} has no committed checkpoint under {self.root}")
+        self._pin(int(step))
         return step_dir
 
     def restore(self, template, shardings=None,
@@ -160,12 +217,21 @@ class Checkpointer:
         """Retention sweep: keep the newest ``keep_last`` committed steps;
         delete older committed ones, and delete interrupted (manifest-less)
         directories that a same-or-newer committed step has superseded —
-        a crashed save can never shadow or outlive real checkpoints."""
+        a crashed save can never shadow or outlive real checkpoints.
+
+        Never deletes the step a reader most recently resolved via
+        ``latest_step()``/``restore()``: a background save pushing that
+        step out of the retention window mid-restore (the retention race)
+        would otherwise yank the files out from under the reader."""
         committed = mf.committed_steps(self.root)
         if not committed:
             return
         newest = committed[-1][0]
-        for _step, step_dir in committed[:-self.keep_last]:
+        with self._pin_lock:
+            pinned = self._last_resolved_step
+        for step, step_dir in committed[:-self.keep_last]:
+            if pinned is not None and step == pinned:
+                continue
             shutil.rmtree(step_dir, ignore_errors=True)
         for step, step_dir in mf.uncommitted_dirs(self.root):
             if step is not None and step <= newest:

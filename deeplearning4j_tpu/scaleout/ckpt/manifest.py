@@ -184,6 +184,67 @@ def read_manifest(step_dir: str) -> Manifest:
         return Manifest.from_json(f.read())
 
 
+# ------------------------------------------------- multi-host part files ----
+# A multi-host save writes one PART manifest per process ("MANIFEST.part-
+# <process>.json", atomic tmp+replace so a reader never sees a torn part),
+# each listing every leaf but only the chunks that process owns. The
+# coordinator merges the parts into the single committed MANIFEST.json —
+# part files are working state, never a commit record: a directory with
+# parts but no manifest is still an interrupted save.
+
+_PART_PREFIX = "MANIFEST.part-"
+
+
+def part_manifest_path(step_dir: str, process_index: int) -> str:
+    return os.path.join(step_dir, f"{_PART_PREFIX}{int(process_index):05d}.json")
+
+
+def write_part_manifest(step_dir: str, process_index: int, step: int,
+                        entries: Sequence[LeafEntry]) -> str:
+    final = part_manifest_path(step_dir, process_index)
+    tmp = f"{final}.tmp-{os.getpid()}-{uuid.uuid4().hex}"
+    payload = {"format": FORMAT, "process": int(process_index),
+               "step": int(step),
+               "leaves": [e.to_dict() for e in entries]}
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return final
+
+
+def list_part_manifests(step_dir: str) -> List[Tuple[int, str]]:
+    """(process_index, path) for every part file present, ascending."""
+    out: List[Tuple[int, str]] = []
+    if not os.path.isdir(step_dir):
+        return out
+    for name in sorted(os.listdir(step_dir)):
+        if not (name.startswith(_PART_PREFIX) and name.endswith(".json")):
+            continue
+        try:
+            idx = int(name[len(_PART_PREFIX):-len(".json")])
+        except ValueError:
+            continue
+        out.append((idx, os.path.join(step_dir, name)))
+    return out
+
+
+def read_part_manifest(path: str) -> Tuple[int, int, Tuple[LeafEntry, ...]]:
+    """→ (process_index, step, leaf entries)."""
+    with open(path, "r", encoding="utf-8") as f:
+        d = json.load(f)
+    if d.get("format") != FORMAT:
+        raise ValueError(f"unsupported part-manifest format "
+                         f"{d.get('format')!r} in {path}")
+    return (int(d["process"]), int(d["step"]),
+            tuple(LeafEntry.from_dict(e) for e in d["leaves"]))
+
+
 def committed_steps(root: str) -> List[Tuple[int, str]]:
     """(step, step_dir) for every COMMITTED checkpoint under root,
     ascending by step. Manifest-less (interrupted) directories are

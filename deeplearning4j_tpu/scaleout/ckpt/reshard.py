@@ -39,6 +39,12 @@ from deeplearning4j_tpu.scaleout.ckpt.manifest import (
 )
 
 
+class CorruptShardError(ValueError):
+    """A chunk's bytes don't match the manifest CRC. The message names the
+    shard file, leaf path, and chunk index so an operator knows exactly
+    which file to re-copy (or which step to abandon)."""
+
+
 def latest_step(root: str) -> Optional[int]:
     """Highest COMMITTED step under root; interrupted (manifest-less)
     directories are ignored."""
@@ -83,11 +89,15 @@ def check_compatible(saved_shape: Tuple[int, ...], saved_dtype: str,
 
 class _ChunkStore:
     """Lazy per-file npz handles so a restore only reads the members the
-    target shards actually cover."""
+    target shards actually cover. With ``verify_crc`` every chunk is
+    CRC-checked once, on first read — silent disk corruption becomes a
+    load-time ``CorruptShardError``, not late training divergence."""
 
-    def __init__(self, step_dir: str):
+    def __init__(self, step_dir: str, verify_crc: bool = False):
         self.step_dir = step_dir
+        self.verify_crc = verify_crc
         self._files: Dict[str, object] = {}
+        self._crc_ok: set = set()
 
     def get(self, fname: str, key: str) -> np.ndarray:
         z = self._files.get(fname)
@@ -95,6 +105,22 @@ class _ChunkStore:
             z = np.load(os.path.join(self.step_dir, fname))
             self._files[fname] = z
         return z[key]
+
+    def get_checked(self, entry: "LeafEntry", chunk_index: int) -> np.ndarray:
+        """Read one chunk of ``entry``, verifying its CRC on first touch."""
+        chunk = entry.chunks[chunk_index]
+        data = self.get(chunk.file, chunk.key)
+        if self.verify_crc and (chunk.file, chunk.key) not in self._crc_ok:
+            crc = zlib.crc32(np.ascontiguousarray(data).tobytes())
+            if crc != chunk.crc32:
+                raise CorruptShardError(
+                    f"checkpoint shard {chunk.file} is corrupt: leaf "
+                    f"{entry.path} chunk {chunk_index} (of "
+                    f"{len(entry.chunks)}, start {tuple(chunk.start)}) "
+                    f"read crc32 {crc} != manifest {chunk.crc32} — re-copy "
+                    f"the shard file or restore a different step")
+            self._crc_ok.add((chunk.file, chunk.key))
+        return data
 
     def close(self) -> None:
         for z in self._files.values():
@@ -133,18 +159,18 @@ def assemble_region(entry: LeafEntry, store: _ChunkStore, index,
     # (same-mesh resume, the common case), hand its array back without the
     # empty-alloc + copy — the resharding assembly below is only paid when
     # the chunking actually changed (e.g. a cross-G expert regroup)
-    for chunk in entry.chunks:
+    for i, chunk in enumerate(entry.chunks):
         if tuple(chunk.start) == starts and tuple(chunk.shape) == tuple(sizes):
-            return np.asarray(store.get(chunk.file, chunk.key), dtype=dtype)
+            return np.asarray(store.get_checked(entry, i), dtype=dtype)
     out = np.empty(sizes, dtype=dtype)
     covered = 0
-    for chunk in entry.chunks:
+    for i, chunk in enumerate(entry.chunks):
         lo = [max(s, cs) for s, cs in zip(starts, chunk.start)]
         hi = [min(s + n, cs + cn)
               for s, n, cs, cn in zip(starts, sizes, chunk.start, chunk.shape)]
         if any(l >= h for l, h in zip(lo, hi)):
             continue
-        data = store.get(chunk.file, chunk.key)
+        data = store.get_checked(entry, i)
         src = tuple(slice(l - cs, h - cs)
                     for l, h, cs in zip(lo, hi, chunk.start))
         dst = tuple(slice(l - s, h - s) for l, h, s in zip(lo, hi, starts))
@@ -161,7 +187,8 @@ def assemble_region(entry: LeafEntry, store: _ChunkStore, index,
     return out
 
 
-def restore_sharded(step_dir: str, template, shardings=None):
+def restore_sharded(step_dir: str, template, shardings=None,
+                    verify_crc: bool = True):
     """Restore the pytree saved in ``step_dir`` into the structure of
     ``template``. Returns ``(state, manifest)``.
 
@@ -173,7 +200,9 @@ def restore_sharded(step_dir: str, template, shardings=None):
     as an ordinary (uncommitted) ``jnp`` array.
 
     Strict by construction: missing leaves, shape mismatches, and lossy
-    dtype narrowing raise (see ``check_compatible``).
+    dtype narrowing raise (see ``check_compatible``); every chunk actually
+    read is CRC-verified (``verify_crc=False`` opts out) so a corrupt
+    shard fails the restore with a ``CorruptShardError`` naming the file.
     """
     import jax.numpy as jnp
 
@@ -190,7 +219,7 @@ def restore_sharded(step_dir: str, template, shardings=None):
                 f"shardings pytree has {len(s_leaves)} leaves, template has "
                 f"{len(t_leaves)}")
     new_leaves = []
-    with _ChunkStore(step_dir) as store:
+    with _ChunkStore(step_dir, verify_crc=verify_crc) as store:
         for (path, t_leaf), sharding in zip(t_leaves, s_leaves):
             key = jax.tree_util.keystr(path)
             entry = by_path.get(key)
@@ -216,23 +245,23 @@ def verify_checksums(step_dir: str) -> List[str]:
     problems: List[str] = []
     with _ChunkStore(step_dir) as store:
         for entry in manifest.leaves:
-            for chunk in entry.chunks:
+            for i, chunk in enumerate(entry.chunks):
+                where = (f"{entry.path} chunk {i} [{chunk.file}, "
+                         f"start {tuple(chunk.start)}]")
                 try:
                     data = np.ascontiguousarray(
                         store.get(chunk.file, chunk.key))
                 except Exception as e:  # missing file/member counts as corrupt
-                    problems.append(
-                        f"{entry.path} [{chunk.file}]: unreadable ({e})")
+                    problems.append(f"{where}: unreadable ({e})")
                     continue
                 crc = zlib.crc32(data.tobytes())
                 if crc != chunk.crc32:
                     problems.append(
-                        f"{entry.path} [{chunk.file}]: crc32 {crc} != "
-                        f"manifest {chunk.crc32}")
+                        f"{where}: crc32 {crc} != manifest {chunk.crc32}")
                 if tuple(data.shape) != chunk.shape:
                     problems.append(
-                        f"{entry.path} [{chunk.file}]: stored shape "
-                        f"{tuple(data.shape)} != manifest {chunk.shape}")
+                        f"{where}: stored shape {tuple(data.shape)} != "
+                        f"manifest {chunk.shape}")
     return problems
 
 

@@ -21,13 +21,19 @@ from typing import Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+import time
+
 from deeplearning4j_tpu.scaleout.ckpt.manifest import (
     Chunk,
     LeafEntry,
     Manifest,
+    list_part_manifests,
+    part_manifest_path,
+    read_part_manifest,
     serialize_spec,
     step_dir_name,
     write_manifest,
+    write_part_manifest,
 )
 
 
@@ -79,6 +85,43 @@ def _leaf_chunks(leaf) -> List[Tuple[int, Tuple[int, ...], np.ndarray]]:
         dev, shard = by_start[start]
         out.append((dev, start, np.asarray(shard.data)))
     return out
+
+
+def _leaf_chunks_for_process(leaf, process_index: int):
+    """The multi-host ownership rule: dedup every rectangle across the
+    GLOBAL shard list onto its lowest-device-id holder, then keep only the
+    rectangles whose owner lives on ``process_index`` — so K processes
+    writing concurrently produce disjoint chunk sets whose union is exactly
+    the one ``save_sharded`` would have written. Returns
+    ``(owned chunks like _leaf_chunks, n_global_unique)``."""
+    shards = getattr(leaf, "global_shards", None)
+    if shards is None:
+        shards = getattr(leaf, "addressable_shards", None)
+    if shards is None:
+        # host array: the coordinator owns it
+        if process_index == 0:
+            arr = np.asarray(leaf)
+            return [(0, (0,) * arr.ndim, arr)], 1
+        return [], 1
+    by_start: Dict[Tuple[int, ...], Tuple[int, int, object]] = {}
+    for shard in shards:
+        start, _sizes = _normalize_index(shard.index, leaf.shape)
+        dev = int(getattr(shard.device, "id", 0))
+        proc = int(getattr(shard.device, "process_index", 0))
+        prev = by_start.get(start)
+        if prev is None or dev < prev[0]:
+            by_start[start] = (dev, proc, shard)
+    owned = []
+    for start in sorted(by_start):
+        dev, proc, shard = by_start[start]
+        if proc != process_index:
+            continue
+        if getattr(shard, "data", None) is None:  # pragma: no cover - guard
+            raise ValueError(
+                f"process {process_index} owns chunk at {start} but its "
+                "data is not addressable here — ownership filter bug")
+        owned.append((dev, start, np.asarray(shard.data)))
+    return owned, len(by_start)
 
 
 def _mesh_topology(state, mesh=None) -> Optional[Dict]:
@@ -137,4 +180,128 @@ def save_sharded(root: str, step: int, state, meta: Optional[Dict] = None,
     manifest = Manifest(step=int(step), leaves=tuple(entries),
                         mesh=_mesh_topology(state, mesh), meta=dict(meta or {}))
     write_manifest(step_dir, manifest)
+    return step_dir
+
+
+# ----------------------------------------------------- multi-host writer ----
+
+def save_process_shards(root: str, step: int, state,
+                        process_index: Optional[int] = None) -> str:
+    """One host's half of a multi-host save: write ONLY the chunks this
+    process's devices own (lowest-global-device-id dedup, so replicas
+    write once cluster-wide) plus an atomic part manifest listing every
+    leaf with this process's chunks. Nothing here is a commit — the
+    directory stays invisible to ``latest_step`` until the coordinator's
+    ``merge_process_manifests`` lands the real manifest LAST."""
+    if process_index is None:
+        process_index = int(getattr(jax, "process_index", lambda: 0)())
+    step_dir = os.path.join(root, step_dir_name(step))
+    os.makedirs(step_dir, exist_ok=True)
+
+    per_file: Dict[str, Dict[str, np.ndarray]] = {}
+    entries: List[LeafEntry] = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        key = jax.tree_util.keystr(path)
+        global_shape = tuple(int(d) for d in np.shape(leaf))
+        dtype = str(np.asarray(
+            leaf.addressable_shards[0].data
+            if getattr(leaf, "addressable_shards", None) else leaf).dtype)
+        chunks: List[Chunk] = []
+        owned, _total = _leaf_chunks_for_process(leaf, process_index)
+        for dev, start, arr in owned:
+            arr = np.ascontiguousarray(arr)
+            fname = _shard_file_name(dev)
+            per_file.setdefault(fname, {})[key] = arr
+            chunks.append(Chunk(file=fname, key=key, start=start,
+                                shape=tuple(int(d) for d in arr.shape),
+                                crc32=zlib.crc32(arr.tobytes())))
+        entries.append(LeafEntry(path=key, shape=global_shape, dtype=dtype,
+                                 spec=_leaf_spec(leaf), chunks=tuple(chunks)))
+
+    for fname, payload in sorted(per_file.items()):
+        with open(os.path.join(step_dir, fname), "wb") as f:
+            np.savez(f, **payload)
+            f.flush()
+            os.fsync(f.fileno())
+
+    write_part_manifest(step_dir, process_index, step, entries)
+    return step_dir
+
+
+def merge_process_manifests(root: str, step: int, n_processes: int,
+                            meta: Optional[Dict] = None, mesh=None,
+                            state=None, timeout_s: float = 120.0,
+                            poll_s: float = 0.05) -> str:
+    """The coordinator's merge barrier: wait for all ``n_processes`` part
+    manifests, union their chunk lists per leaf, validate that the union
+    exactly covers every leaf's global shape, THEN commit the single
+    manifest atomically and remove the parts. A coordinator killed at any
+    point before the final rename leaves no committed manifest — readers
+    still resume from the previous step and retention sweeps the debris."""
+    step_dir = os.path.join(root, step_dir_name(step))
+    deadline = time.monotonic() + timeout_s
+    while True:
+        parts = list_part_manifests(step_dir)
+        if len(parts) >= int(n_processes):
+            break
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"manifest merge barrier: {len(parts)}/{n_processes} part "
+                f"manifests present in {step_dir} after {timeout_s}s "
+                f"(have processes {[i for i, _ in parts]})")
+        time.sleep(poll_s)
+
+    merged: Dict[str, LeafEntry] = {}
+    order: List[str] = []
+    for proc_idx, path in parts:
+        got_idx, got_step, entries = read_part_manifest(path)
+        if got_step != int(step):
+            raise ValueError(
+                f"part manifest {path} is for step {got_step}, merging "
+                f"step {step}")
+        for entry in entries:
+            prev = merged.get(entry.path)
+            if prev is None:
+                merged[entry.path] = entry
+                order.append(entry.path)
+                continue
+            if (prev.shape != entry.shape or prev.dtype != entry.dtype):
+                raise ValueError(
+                    f"part manifests disagree on leaf {entry.path}: "
+                    f"{prev.shape}/{prev.dtype} vs "
+                    f"{entry.shape}/{entry.dtype}")
+            merged[entry.path] = LeafEntry(
+                path=prev.path, shape=prev.shape, dtype=prev.dtype,
+                spec=prev.spec if prev.spec is not None else entry.spec,
+                chunks=prev.chunks + entry.chunks)
+
+    # coverage check BEFORE commit: disjoint-by-construction chunks must
+    # tile each leaf exactly — a missing host's chunks fail here, loudly
+    for path in order:
+        entry = merged[path]
+        want = 1
+        for dim in entry.shape:
+            want *= dim
+        got = 0
+        for chunk in entry.chunks:
+            vol = 1
+            for dim in chunk.shape:
+                vol *= dim
+            got += vol
+        if got != want:
+            raise ValueError(
+                f"merge barrier: leaf {path} chunks cover {got} of {want} "
+                f"elements — a host's shards are missing; refusing to "
+                "commit a hole-y checkpoint")
+
+    manifest = Manifest(step=int(step),
+                        leaves=tuple(merged[p] for p in order),
+                        mesh=_mesh_topology(state, mesh) if state is not None
+                        or mesh is not None else None,
+                        meta=dict(meta or {}))
+    write_manifest(step_dir, manifest)
+    for proc_idx, _path in parts:
+        part = part_manifest_path(step_dir, proc_idx)
+        if os.path.exists(part):
+            os.unlink(part)
     return step_dir

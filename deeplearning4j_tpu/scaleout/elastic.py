@@ -1,0 +1,757 @@
+"""Elastic multi-host parameter-averaging training (ISSUE 6 / ROADMAP 3).
+
+SparkNet (arXiv:1511.06051) trains by running every worker's local SGD for a
+fixed window and averaging parameters infrequently; DeepSpark
+(arXiv:1602.08191) relaxes the barrier with a bounded-staleness knob. That
+sync model is exactly what makes elastic membership cheap: the only state a
+worker uniquely owns is its *unsynced* delta, so a crashed worker costs one
+round's local progress — never the run.
+
+Topology: ``ElasticMaster`` embeds a ``StateTrackerServer`` (control plane:
+membership, heartbeats, round counters) and shares a ``BlobStore`` (data
+plane: parameter trees) with K ``ElasticWorker`` OS processes, each running
+its own single-host JAX runtime and a jitted mesh train step over a
+deterministic per-worker data stream.
+
+Round protocol (global *versions* ``g = 0, 1, …``; version 0 is the initial
+params, version ``g`` averages round ``g-1``'s contributions):
+
+- worker: adopt the freshest committed global version, run ``sync_every``
+  local steps, publish its params as the round-``r`` contribution, advance.
+  With ``max_staleness = 0`` this is bulk-synchronous (wait for version
+  ``r+1`` before round ``r+1``); with ``s > 0`` the worker keeps training on
+  its local chain up to ``s`` rounds ahead of the last committed version
+  (DeepSpark), adopting the freshest global whenever one is available.
+- master: a round commits when every *live* worker admitted at-or-before it
+  has contributed; heartbeat-stale workers are deregistered mid-barrier, so
+  a kill -9 turns into a shrunk survivor set, not a hung barrier. The
+  commit averages all contributions received for the round (weighted by
+  local step count), publishes the new version, bumps ``elastic.version``.
+
+Membership: a worker that registers mid-run (rejoin or replacement) pulls
+the current version's params + step and is admitted from the current round
+(``admit.<wid>`` counter) — earlier barriers never wait for it.
+``min_workers`` picks degrade-vs-halt: the run continues on any survivor
+set of at least ``min_workers``, and raises ``ElasticTrainingError`` below
+that.
+
+Persistence: the master checkpoints the averaged params through
+``scaleout.ckpt`` (optionally via ``AsyncCheckpointer`` so snapshots stay
+off the training/aggregation thread) and ``resume()`` restarts from the
+latest committed version.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import io
+import json
+import logging
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.scaleout.blobstore import BlobStore, open_store
+from deeplearning4j_tpu.scaleout.remote_tracker import (
+    StateTrackerClient,
+    StateTrackerServer,
+    TrackerUnavailable,
+)
+
+log = logging.getLogger(__name__)
+
+VERSION_KEY = "elastic.version"
+
+
+class ElasticTrainingError(RuntimeError):
+    """The run can no longer make progress (survivor set below
+    ``min_workers``, or a round barrier timed out with no contributions)."""
+
+
+# --------------------------------------------------------------- trees ----
+
+def tree_to_bytes(tree, meta: Optional[Dict] = None) -> bytes:
+    """Serialize a pytree of array leaves (+ JSON-able meta) to npz bytes.
+    Leaves are keyed by their ``keystr`` path, so any process holding the
+    same tree *structure* can deserialize without sharing code objects —
+    the data-plane twin of the tracker's pickle frames."""
+    import jax
+
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    payload = {f"leaf_{i}": np.asarray(leaf) for i, (_, leaf) in
+               enumerate(leaves)}
+    payload["__paths__"] = np.frombuffer(json.dumps(
+        [jax.tree_util.keystr(p) for p, _ in leaves]).encode(), np.uint8)
+    payload["__meta__"] = np.frombuffer(
+        json.dumps(meta or {}).encode(), np.uint8)
+    buf = io.BytesIO()
+    np.savez(buf, **payload)
+    return buf.getvalue()
+
+
+def tree_from_bytes(data: bytes, template) -> Tuple[object, Dict]:
+    """Rebuild ``(tree, meta)`` from ``tree_to_bytes`` output into the
+    structure of ``template``. Strict: the saved paths must be exactly the
+    template's paths, in order — a structure mismatch is a loud error, not
+    a silently misassigned parameter."""
+    import jax
+
+    with np.load(io.BytesIO(data)) as z:
+        paths = json.loads(bytes(z["__paths__"]).decode())
+        meta = json.loads(bytes(z["__meta__"]).decode())
+        leaves = [np.asarray(z[f"leaf_{i}"]) for i in range(len(paths))]
+    t_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+    want = [jax.tree_util.keystr(p) for p, _ in t_leaves]
+    if want != paths:
+        raise ValueError(
+            f"elastic tree structure mismatch: payload has {paths}, "
+            f"template expects {want}")
+    return jax.tree_util.tree_unflatten(treedef, leaves), meta
+
+
+def average_trees(trees: List, weights: List[float]):
+    """Weighted parameter average, deterministic: float64 accumulation in a
+    fixed caller-supplied order, cast back to each leaf's dtype. Both the
+    master and the in-process parity reference (``simulate_elastic``) go
+    through this exact function, so 'matches within tolerance' is limited
+    by training math, not by averaging-order noise."""
+    import jax
+
+    if not trees:
+        raise ValueError("cannot average zero contributions")
+    total = float(sum(weights))
+    flats = [jax.tree_util.tree_flatten(t) for t in trees]
+    treedef = flats[0][1]
+    n_leaves = len(flats[0][0])
+    out = []
+    for i in range(n_leaves):
+        acc = np.zeros_like(np.asarray(flats[0][0][i], np.float64))
+        for (leaves, _), w in zip(flats, weights):
+            acc += np.asarray(leaves[i], np.float64) * (w / total)
+        out.append(acc.astype(np.asarray(flats[0][0][i]).dtype))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# --------------------------------------------------------------- model ----
+
+class ElasticModel:
+    """What a worker trains. ``run_steps`` owns the jit/mesh/data details;
+    the framework only moves host trees around it."""
+
+    def init_params(self):
+        raise NotImplementedError
+
+    def run_steps(self, params, start_step: int, n_steps: int,
+                  worker_seed: int):
+        """Advance ``params`` by ``n_steps`` local steps whose data stream
+        is a pure function of ``(worker_seed, step_index)`` — so a
+        survivor's trajectory is identical whether or not other workers
+        exist. Returns ``(params, last_loss: float)``."""
+        raise NotImplementedError
+
+
+class SyntheticRegressionModel(ElasticModel):
+    """Teacher-student MLP regression with a jitted data-parallel mesh
+    step — the reference workload for elastic tests and the SparkNet
+    sync-period bench. Deterministic end to end: params from a fixed init
+    key, batches from ``fold_in(data_key, worker_seed, step)``."""
+
+    def __init__(self, d_in: int = 8, d_hidden: int = 16, batch: int = 32,
+                 lr: float = 0.05, seed: int = 0, mesh_devices: int = 2):
+        self.d_in, self.d_hidden = int(d_in), int(d_hidden)
+        self.batch, self.lr, self.seed = int(batch), float(lr), int(seed)
+        self.mesh_devices = int(mesh_devices)
+        self._step = None
+        self._mesh = None
+
+    def init_params(self):
+        import jax
+        import jax.numpy as jnp
+
+        k1, k2 = jax.random.split(jax.random.PRNGKey(self.seed))
+        scale = 1.0 / np.sqrt(self.d_in)
+        return {
+            "w1": jax.random.normal(k1, (self.d_in, self.d_hidden),
+                                    jnp.float32) * scale,
+            "b1": jnp.zeros((self.d_hidden,), jnp.float32),
+            "w2": jax.random.normal(k2, (self.d_hidden, 1),
+                                    jnp.float32) * scale,
+        }
+
+    def _teacher(self):
+        import jax
+
+        k = jax.random.PRNGKey(self.seed + 1000)
+        return jax.random.normal(k, (self.d_in, 1))
+
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        n = max(1, min(self.mesh_devices, len(jax.devices())))
+        n = max(d for d in range(1, n + 1) if self.batch % d == 0)
+        self._mesh = Mesh(np.array(jax.devices()[:n]), ("data",))
+        self._batch_sharding = NamedSharding(self._mesh, P("data"))
+        self._rep_sharding = NamedSharding(self._mesh, P())
+        lr = self.lr
+
+        def step(params, x, y):
+            def loss_fn(p):
+                h = jnp.tanh(x @ p["w1"] + p["b1"])
+                pred = h @ p["w2"]
+                return jnp.mean((pred - y) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            new = jax.tree_util.tree_map(lambda p, g: p - lr * g,
+                                         params, grads)
+            return new, loss
+
+        self._step = jax.jit(step, donate_argnums=(0,))
+
+    def _batch_for(self, worker_seed: int, step_index: int):
+        import jax
+
+        k = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(self.seed + 7),
+                               int(worker_seed)), int(step_index))
+        x = jax.random.normal(k, (self.batch, self.d_in))
+        y = x @ self._teacher()
+        return np.asarray(x), np.asarray(y)
+
+    def eval_loss(self, params, n_batches: int = 8,
+                  eval_seed: int = 10_007) -> float:
+        """Deterministic held-out MSE — the metric the SparkNet
+        sync-period A/B compares across ``sync_every`` settings."""
+        import jax
+        import jax.numpy as jnp
+
+        p = jax.tree_util.tree_map(jnp.asarray, params)
+        total = 0.0
+        for i in range(int(n_batches)):
+            x, y = self._batch_for(eval_seed, i)
+            h = jnp.tanh(jnp.asarray(x) @ p["w1"] + p["b1"])
+            total += float(jnp.mean((h @ p["w2"] - jnp.asarray(y)) ** 2))
+        return total / n_batches
+
+    def run_steps(self, params, start_step: int, n_steps: int,
+                  worker_seed: int):
+        import jax
+
+        if self._step is None:
+            self._build()
+        params = jax.device_put(
+            jax.tree_util.tree_map(np.asarray, params), self._rep_sharding)
+        loss = None
+        for i in range(int(n_steps)):
+            x, y = self._batch_for(worker_seed, start_step + i)
+            params, loss = self._step(
+                params,
+                jax.device_put(x, self._batch_sharding),
+                jax.device_put(y, self._batch_sharding))
+        host = jax.tree_util.tree_map(np.asarray, jax.device_get(params))
+        return host, (float(loss) if loss is not None else float("nan"))
+
+
+def synthetic_regression_model(**kwargs) -> SyntheticRegressionModel:
+    """CLI factory (``--model deeplearning4j_tpu.scaleout.elastic:
+    synthetic_regression_model``)."""
+    return SyntheticRegressionModel(**kwargs)
+
+
+# ---------------------------------------------------------- blob layout ----
+
+def _global_key(version: int) -> str:
+    return f"elastic/global/round_{int(version):06d}.npz"
+
+
+def _contrib_key(rnd: int, worker_id: str) -> str:
+    return f"elastic/contrib/round_{int(rnd):06d}/{worker_id}.npz"
+
+
+# --------------------------------------------------------------- worker ----
+
+class ElasticWorker:
+    """One elastic training process: register → adopt global params →
+    ``sync_every`` local jitted steps → publish contribution → repeat.
+
+    Transport robustness: every tracker interaction goes through the
+    hardened ``StateTrackerClient`` (timeouts + idempotent retries), and a
+    ``TrackerUnavailable`` in the main loop is absorbed as a stall —
+    reconnect, re-register (idempotent), continue — so a master restart or
+    a flaky link degrades throughput instead of killing the worker."""
+
+    def __init__(self, address: str, blob_uri: str, model: ElasticModel,
+                 worker_id: Optional[str] = None, sync_every: int = 4,
+                 max_staleness: int = 0, worker_seed: Optional[int] = None,
+                 poll_s: float = 0.02, heartbeat_s: float = 0.25,
+                 round_timeout_s: float = 60.0,
+                 request_timeout_s: float = 5.0,
+                 crash_at_round: Optional[int] = None,
+                 crash_after_steps: int = 1):
+        self.address = address
+        self.blob: BlobStore = open_store(blob_uri)
+        self.model = model
+        self.worker_id = worker_id or f"ew-{uuid.uuid4().hex[:8]}"
+        self.sync_every = max(1, int(sync_every))
+        self.max_staleness = max(0, int(max_staleness))
+        self.worker_seed = (int(worker_seed) if worker_seed is not None
+                            else abs(hash(self.worker_id)) % (1 << 31))
+        self.poll_s = poll_s
+        self.heartbeat_s = heartbeat_s
+        self.round_timeout_s = round_timeout_s
+        self.request_timeout_s = request_timeout_s
+        # fault injection (tests): hard-exit (os._exit, no cleanup) after
+        # ``crash_after_steps`` LOCAL steps of round ``crash_at_round`` —
+        # mid-round, before that round's contribution is published
+        self.crash_at_round = crash_at_round
+        self.crash_after_steps = max(0, int(crash_after_steps))
+        self.tracker: Optional[StateTrackerClient] = None
+        self.round = 0          # next round this worker will contribute to
+        self.local_step = 0
+
+    # -- tracker plumbing --
+    def _connect(self) -> StateTrackerClient:
+        return StateTrackerClient(self.address, timeout=10.0,
+                                  request_timeout_s=self.request_timeout_s)
+
+    def _register(self) -> None:
+        t = self.tracker
+        t.add_worker(self.worker_id)
+        t.increment(f"hb.{self.worker_id}")
+
+    def _heartbeat_loop(self, stop: threading.Event) -> None:
+        # separate connection: the main loop's RPCs (and its stalls) must
+        # never delay the liveness signal the master watches
+        try:
+            hb = self._connect()
+        except (ConnectionError, OSError):
+            return
+        try:
+            while not stop.is_set():
+                hb.increment(f"hb.{self.worker_id}")
+                stop.wait(self.heartbeat_s)
+        except (ConnectionError, OSError):
+            return  # TrackerUnavailable included; master will see us stale
+        finally:
+            hb.close()
+
+    # -- protocol steps --
+    def _committed_version(self) -> int:
+        return int(self.tracker.count(VERSION_KEY))
+
+    def _adopt(self, version: int, template):
+        data = self.blob.try_get(_global_key(version))
+        if data is None:
+            return None
+        tree, meta = tree_from_bytes(data, template)
+        return tree, meta
+
+    def _wait_version_at_least(self, version: int, deadline: float) -> int:
+        while True:
+            if self.tracker.is_done():
+                return -1
+            v = self._committed_version()
+            if v >= version:
+                return v
+            if time.monotonic() > deadline:
+                raise ElasticTrainingError(
+                    f"worker {self.worker_id}: global version {version} not "
+                    f"committed within {self.round_timeout_s}s (stuck at {v})")
+            time.sleep(self.poll_s)
+
+    def _publish(self, rnd: int, params, loss: float) -> None:
+        self.blob.put(_contrib_key(rnd, self.worker_id), tree_to_bytes(
+            params, {"round": rnd, "worker": self.worker_id,
+                     "n_steps": self.sync_every, "loss": loss}))
+        # signal AFTER the atomic blob publish: a counter without a blob
+        # can never be observed
+        self.tracker.increment(f"contrib.{rnd}.{self.worker_id}")
+
+    def run(self) -> Dict:
+        """Train until the master finishes. Returns a summary dict
+        (final round/step — what the rejoin test asserts on)."""
+        self.tracker = self._connect()
+        template = self.model.init_params()
+        stop = threading.Event()
+        hb = threading.Thread(target=self._heartbeat_loop, args=(stop,),
+                              daemon=True)
+        try:
+            # join at the CURRENT version: pull averaged params + step and
+            # get admitted from this round — the rejoin path and the cold
+            # start are the same code
+            v = self._committed_version()
+            adopted = None
+            deadline = time.monotonic() + self.round_timeout_s
+            while adopted is None:
+                adopted = self._adopt(v, template)
+                if adopted is None:
+                    if time.monotonic() > deadline:
+                        raise ElasticTrainingError(
+                            f"worker {self.worker_id}: no global params "
+                            f"blob for version {v}")
+                    time.sleep(self.poll_s)
+            params, meta = adopted
+            self.round = v
+            self.local_step = int(meta.get("step", v * self.sync_every))
+            if v > 0:
+                self.tracker.increment("elastic.joined")
+            self.tracker.increment(f"admit.{self.worker_id}", float(v))
+            self._register()
+            hb.start()
+            params = self._run_rounds(params, template)
+            return {"worker_id": self.worker_id, "round": self.round,
+                    "step": self.local_step}
+        finally:
+            stop.set()
+            if self.tracker is not None:
+                self.tracker.close()
+
+    def _run_rounds(self, params, template):
+        last_ok = time.monotonic()
+        while True:
+            try:
+                if self.tracker.is_done():
+                    return params
+                # adopt the freshest committed version we haven't seen;
+                # jump forward if the cluster moved on without us
+                v = self._committed_version()
+                if v >= self.round:
+                    adopted = self._adopt(v, template)
+                    if adopted is not None:
+                        params, meta = adopted
+                        self.round = v
+                        self.local_step = int(
+                            meta.get("step", v * self.sync_every))
+                rnd = self.round
+                if self.crash_at_round is not None and \
+                        rnd >= self.crash_at_round:
+                    import os as _os
+
+                    params, _ = self.model.run_steps(
+                        params, self.local_step, self.crash_after_steps,
+                        self.worker_seed)
+                    _os._exit(23)  # kill -9 analogue: mid-round, unsynced
+                params, loss = self.model.run_steps(
+                    params, self.local_step, self.sync_every,
+                    self.worker_seed)
+                self.local_step += self.sync_every
+                self._publish(rnd, params, loss)
+                self.round = rnd + 1
+                # DeepSpark staleness window: block only once our lead over
+                # the committed version exceeds max_staleness
+                got = self._wait_version_at_least(
+                    self.round - self.max_staleness,
+                    time.monotonic() + self.round_timeout_s)
+                if got < 0:
+                    return params
+                last_ok = time.monotonic()
+            except TrackerUnavailable:
+                # master restart / dropped link: stall, reconnect,
+                # re-register (idempotent), carry on from local state —
+                # bounded by round_timeout_s so a dead master is
+                # eventually a loud failure, not a silent spin
+                if time.monotonic() - last_ok > self.round_timeout_s:
+                    raise
+                time.sleep(self.poll_s * 5)
+                try:
+                    self.tracker.close()
+                    self.tracker = self._connect()
+                    self._register()
+                except (ConnectionError, OSError):
+                    continue
+
+
+# --------------------------------------------------------------- master ----
+
+class ElasticMaster:
+    """The elastic counterpart of ``distributed_runner.DistributedMaster``:
+    embeds the tracker server, owns the blob store, commits averaging
+    rounds over whatever survivor set is alive, and checkpoints the
+    averaged params. ``train(rounds)`` returns the final averaged tree."""
+
+    def __init__(self, model: ElasticModel, blob_uri: str,
+                 server: Optional[StateTrackerServer] = None,
+                 initial_params=None, start_version: int = 0,
+                 sync_every: int = 4, min_workers: int = 1,
+                 worker_timeout_s: float = 5.0,
+                 register_timeout_s: float = 60.0,
+                 round_timeout_s: float = 120.0, tick_s: float = 0.01,
+                 checkpointer=None, checkpoint_every: int = 0,
+                 registry=None):
+        from deeplearning4j_tpu.telemetry.registry import default_registry
+
+        self.server = server or StateTrackerServer()
+        self.tracker = self.server.tracker  # embedded: zero-IPC master side
+        self.blob_uri = blob_uri
+        self.blob = open_store(blob_uri)
+        self.model = model
+        self.sync_every = max(1, int(sync_every))
+        self.min_workers = max(1, int(min_workers))
+        self.worker_timeout_s = worker_timeout_s
+        self.register_timeout_s = register_timeout_s
+        self.round_timeout_s = round_timeout_s
+        self.tick_s = tick_s
+        self.checkpointer = checkpointer
+        self.checkpoint_every = int(checkpoint_every)
+        self.registry = registry if registry is not None else default_registry()
+        self.version = int(start_version)
+        self._params = (initial_params if initial_params is not None
+                        else self.model.init_params())
+        self._params = _host_tree(self._params)
+        self._template = self.model.init_params()
+        self._hb_seen: Dict[str, tuple] = {}
+        self._admit: Dict[str, int] = {}
+        self._publish_version(self.version, self._params)
+
+    # -- plumbing --
+    @property
+    def address(self) -> str:
+        return self.server.address
+
+    def _publish_version(self, version: int, params) -> None:
+        self.blob.put(_global_key(version), tree_to_bytes(
+            params, {"version": version,
+                     "step": version * self.sync_every}))
+        # the counter IS the committed-version number; a resume can jump it
+        # by more than one
+        behind = version - self.tracker.count(VERSION_KEY)
+        if behind > 0:
+            self.tracker.increment(VERSION_KEY, float(behind))
+        self.registry.gauge("elastic_version").set(float(version))
+
+    def _live_workers(self) -> List[str]:
+        return list(self.tracker.workers())
+
+    def _dead_workers(self) -> List[str]:
+        now = time.monotonic()
+        dead = []
+        for wid in self._live_workers():
+            count = self.tracker.count(f"hb.{wid}")
+            seen = self._hb_seen.get(wid)
+            if seen is None or seen[0] != count:
+                self._hb_seen[wid] = (count, now)
+            elif now - seen[1] > self.worker_timeout_s:
+                dead.append(wid)
+        return dead
+
+    def _bury(self, wid: str) -> None:
+        self.tracker.remove_worker(wid)
+        self._hb_seen.pop(wid, None)
+        self.tracker.increment("workers_failed")
+        self.registry.counter("elastic_workers_failed_total").inc()
+        log.warning("elastic worker %s heartbeat stale >%ss: deregistered; "
+                    "continuing on the survivor set", wid,
+                    self.worker_timeout_s)
+
+    def _admit_round(self, wid: str) -> int:
+        if wid not in self._admit:
+            self._admit[wid] = int(self.tracker.count(f"admit.{wid}"))
+            if self._admit[wid] > 0:
+                self.registry.counter("elastic_workers_joined_total").inc()
+        return self._admit[wid]
+
+    def _contributions(self, rnd: int) -> Dict[str, tuple]:
+        """(tree, n_steps) per worker that has a committed contribution
+        blob for ``rnd`` — includes workers that died after publishing
+        (their synced work is kept; only unsynced deltas are lost)."""
+        out: Dict[str, tuple] = {}
+        signals = self.tracker.counters_snapshot(f"contrib.{rnd}.")
+        template = self._template
+        for key, val in signals.items():
+            if val <= 0:
+                continue
+            wid = key[len(f"contrib.{rnd}."):]
+            data = self.blob.try_get(_contrib_key(rnd, wid))
+            if data is None:
+                continue  # signal raced the (atomic) blob publish; re-poll
+            tree, meta = tree_from_bytes(data, template)
+            out[wid] = (tree, float(meta.get("n_steps", self.sync_every)))
+        return out
+
+    # -- lifecycle --
+    def wait_for_workers(self, n: Optional[int] = None) -> None:
+        need = n if n is not None else self.min_workers
+        deadline = time.monotonic() + self.register_timeout_s
+        while len(self._live_workers()) < need:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"{len(self._live_workers())}/{need} elastic workers "
+                    f"registered within {self.register_timeout_s}s")
+            time.sleep(0.05)
+
+    def _maybe_checkpoint(self, version: int) -> None:
+        if (self.checkpointer is None or self.checkpoint_every <= 0
+                or version % self.checkpoint_every):
+            return
+        self.checkpointer.save(
+            version, {"params": self._params},
+            meta={"elastic_version": version,
+                  "elastic_step": version * self.sync_every,
+                  "sync_every": self.sync_every})
+
+    def train(self, rounds: int, finish: bool = True):
+        """Commit ``rounds`` averaging rounds (versions ``start+1 ..
+        start+rounds``); returns the final averaged host tree.
+        ``finish=False`` keeps the cluster alive (workers park at the
+        staleness gate) so a later ``train`` call can continue the run —
+        the rejoin tests use the gap to admit replacements
+        deterministically."""
+        ok = False
+        try:
+            target = self.version + int(rounds)
+            while self.version < target:
+                rnd = self.version  # collecting round ``rnd`` contributions
+                deadline = time.monotonic() + self.round_timeout_s
+                while True:
+                    for wid in self._dead_workers():
+                        self._bury(wid)
+                    live = self._live_workers()
+                    self.registry.gauge("elastic_live_workers").set(
+                        float(len(live)))
+                    if len(live) < self.min_workers:
+                        raise ElasticTrainingError(
+                            f"survivor set {live} below min_workers="
+                            f"{self.min_workers} at round {rnd} — halting "
+                            "(raise min_workers tolerance or add workers)")
+                    contribs = self._contributions(rnd)
+                    required = [w for w in live
+                                if self._admit_round(w) <= rnd]
+                    if required and all(w in contribs for w in required):
+                        break
+                    if time.monotonic() > deadline:
+                        raise ElasticTrainingError(
+                            f"round {rnd} barrier timed out after "
+                            f"{self.round_timeout_s}s: live={live} "
+                            f"contributed={sorted(contribs)}")
+                    time.sleep(self.tick_s)
+                wids = sorted(contribs)  # deterministic averaging order
+                self._params = average_trees(
+                    [contribs[w][0] for w in wids],
+                    [contribs[w][1] for w in wids])
+                self.version += 1
+                self._publish_version(self.version, self._params)
+                self.registry.counter("elastic_rounds_total").inc()
+                self.tracker.increment("rounds_completed")
+                self._maybe_checkpoint(self.version)
+            ok = True
+            return self._params
+        finally:
+            if finish or not ok:  # a failed run always releases the
+                self.tracker.finish()  # workers' poll loops
+
+    def resume(self) -> Optional[int]:
+        """Adopt the latest committed checkpoint (params + version); call
+        before ``train``. Returns the resumed version or None."""
+        if self.checkpointer is None:
+            return None
+        step = self.checkpointer.latest_step()
+        if step is None:
+            return None
+        template = {"params": self.model.init_params()}
+        state, version, meta = self.checkpointer.restore(template, step=step)
+        self._params = _host_tree(state["params"])
+        self.version = int(meta.get("elastic_version", version))
+        self._publish_version(self.version, self._params)
+        return self.version
+
+    def params(self):
+        return self._params
+
+    def shutdown(self) -> None:
+        if self.checkpointer is not None and hasattr(self.checkpointer,
+                                                     "flush"):
+            self.checkpointer.flush()
+        self.server.shutdown()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+
+def _host_tree(tree):
+    import jax
+
+    return jax.tree_util.tree_map(np.asarray, jax.device_get(tree))
+
+
+# ----------------------------------------------------- parity reference ----
+
+def simulate_elastic(model: ElasticModel, worker_seeds: List[int],
+                     sync_every: int, rounds: int,
+                     schedule: Optional[Dict[int, List[int]]] = None):
+    """In-process reference of the round protocol: same adoption, same
+    local-step indexing, same ``average_trees`` math — the oracle the
+    multi-process fault tests compare against. ``schedule`` optionally maps
+    round → the subset of worker indices contributing that round (models a
+    mid-run kill or rejoin); default: everyone, every round. Returns
+    ``(final_params, per_round_losses)``."""
+    global_params = _host_tree(model.init_params())
+    losses: List[float] = []
+    for rnd in range(int(rounds)):
+        present = (schedule.get(rnd) if schedule is not None else None)
+        idxs = list(range(len(worker_seeds))) if present is None else present
+        if not idxs:
+            raise ElasticTrainingError(f"simulated round {rnd} has no "
+                                       "contributors")
+        trees, weights, rl = [], [], []
+        for i in idxs:
+            p, loss = model.run_steps(global_params, rnd * sync_every,
+                                      sync_every, worker_seeds[i])
+            trees.append(_host_tree(p))
+            weights.append(float(sync_every))
+            rl.append(loss)
+        global_params = average_trees(trees, weights)
+        losses.append(float(np.mean(rl)))
+    return global_params, losses
+
+
+# ------------------------------------------------------------------ CLI ----
+
+def _resolve_model(spec: str, kwargs: dict) -> ElasticModel:
+    """"pkg.module:factory" → factory(**kwargs) -> ElasticModel."""
+    module_name, _, attr = spec.partition(":")
+    factory = getattr(importlib.import_module(module_name), attr)
+    return factory(**kwargs)
+
+
+def worker_main(argv=None) -> None:
+    """CLI worker entry: ``python -m deeplearning4j_tpu.scaleout.elastic
+    --connect HOST:PORT --blob URI --model pkg.mod:factory [...]`` — the
+    elastic analogue of ``distributed_runner.worker_main``."""
+    p = argparse.ArgumentParser(description="elastic training worker")
+    p.add_argument("--connect", required=True, help="master tracker host:port")
+    p.add_argument("--blob", required=True, help="shared blob store URI")
+    p.add_argument("--model", required=True,
+                   help="pkg.module:factory for the ElasticModel")
+    p.add_argument("--kwargs-json", default="{}",
+                   help="JSON kwargs for the model factory")
+    p.add_argument("--worker-id", default=None)
+    p.add_argument("--worker-seed", type=int, default=None)
+    p.add_argument("--sync-every", type=int, default=4)
+    p.add_argument("--max-staleness", type=int, default=0)
+    p.add_argument("--round-timeout-s", type=float, default=60.0)
+    p.add_argument("--crash-at-round", type=int, default=None,
+                   help="fault injection: os._exit mid-round at round N")
+    p.add_argument("--crash-after-steps", type=int, default=1,
+                   help="local steps to run inside the crashing round")
+    args = p.parse_args(argv)
+    model = _resolve_model(args.model, json.loads(args.kwargs_json))
+    worker = ElasticWorker(
+        args.connect, args.blob, model, worker_id=args.worker_id,
+        sync_every=args.sync_every, max_staleness=args.max_staleness,
+        worker_seed=args.worker_seed, round_timeout_s=args.round_timeout_s,
+        crash_at_round=args.crash_at_round,
+        crash_after_steps=args.crash_after_steps)
+    summary = worker.run()
+    print("ELASTIC_WORKER_DONE " + json.dumps(summary), flush=True)
+
+
+if __name__ == "__main__":
+    worker_main()

@@ -24,15 +24,30 @@ pickling. The server versions every update; ``updates()`` on the client
 remembers each snapshot's versions and ``clear_updates`` sends them, so
 the compare-and-delete happens server-side with the same no-lost-update
 guarantee (a newer unseen snapshot is never deleted unaggregated).
+
+Transport fault model (ISSUE 6): every client socket carries a connect AND
+a per-request timeout — a hung or restarting master turns into a bounded
+stall, never a thread blocked forever in ``recv``. Idempotent calls (reads,
+and the last-write-wins / compare-and-delete writes) are retried on a fresh
+connection with bounded jittered backoff; non-idempotent calls
+(``increment``, blind ``clear_updates``) fail fast, because a retry after a
+lost response could double-apply. Every transport failure surfaces as
+``TrackerUnavailable`` (a ``ConnectionError`` subclass, so existing
+handlers keep working) rather than a bare socket error, and reconnects /
+retries / failures land in the telemetry registry
+(``tracker_reconnects_total`` / ``tracker_retries_total`` /
+``tracker_failures_total``).
 """
 
 from __future__ import annotations
 
 import pickle
+import random
 import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Any, Dict, Optional
 
 from deeplearning4j_tpu.scaleout.job import Job
@@ -43,6 +58,29 @@ from deeplearning4j_tpu.scaleout.statetracker import (
 
 _HDR = struct.Struct(">I")
 _MAX_FRAME = 1 << 30
+
+
+class TrackerUnavailable(ConnectionError):
+    """The tracker could not be reached (connect/request timeout, broken
+    frame, or retry budget exhausted). Subclasses ``ConnectionError`` so
+    pre-existing ``except (ConnectionError, OSError)`` handlers — worker
+    heartbeat loops, poll loops — keep treating it as a transport fault."""
+
+
+# Calls safe to re-issue after an ambiguous failure (the request may or may
+# not have been applied before the connection broke): pure reads, and writes
+# that are last-write-wins per key or compare-and-delete. ``increment`` and
+# the blind ``clear_updates`` are excluded — replaying either can
+# double-apply (double-count / drop an update that landed in between).
+_IDEMPOTENT = frozenset({
+    "add_worker", "remove_worker", "workers",
+    "add_job", "job_for", "clear_job", "has_pending_jobs",
+    "add_update", "updates_versioned", "clear_updates_versioned",
+    "set_current", "get_current",
+    "add_replicate", "needs_replicate", "done_replicating",
+    "count", "counters_snapshot", "finish", "is_done",
+    "set_best_loss", "best_loss", "early_stop", "is_early_stop",
+})
 
 
 def _send_frame(sock: socket.socket, obj: Any) -> None:
@@ -155,30 +193,93 @@ class StateTrackerServer:
 
 class StateTrackerClient(StateTracker):
     """The "worker" Hazelcast client: every StateTracker method is one RPC
-    to the master's server. Thread-safe (one socket, request lock)."""
+    to the master's server. Thread-safe (one socket, request lock).
 
-    def __init__(self, address: str, timeout: float = 30.0):
+    ``timeout`` bounds the TCP connect; ``request_timeout_s`` bounds every
+    request/response round trip (a hung master is a ``TrackerUnavailable``
+    after that many seconds, not a forever-blocked worker thread).
+    Idempotent calls are retried up to ``retries`` times on a fresh
+    connection with jittered exponential backoff; a broken frame mid-stream
+    (master restart, dropped proxy) triggers the same reconnect path."""
+
+    def __init__(self, address: str, timeout: float = 30.0,
+                 request_timeout_s: float = 10.0, retries: int = 3,
+                 backoff_s: float = 0.05, max_backoff_s: float = 1.0,
+                 registry=None):
         host, _, port = address.rpartition(":")
-        self._sock = socket.create_connection((host, int(port)),
-                                              timeout=timeout)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._addr = (host, int(port))
+        self._connect_timeout = timeout
+        self._request_timeout_s = request_timeout_s
+        self._retries = max(0, int(retries))
+        self._backoff_s = backoff_s
+        self._max_backoff_s = max_backoff_s
+        if registry is None:
+            from deeplearning4j_tpu.telemetry.registry import default_registry
+
+            registry = default_registry()
+        self._registry = registry
         self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
         # version bookkeeping for clear_updates(expected) — see module doc
         self._snapshot_versions: Dict[int, Dict[str, int]] = {}
+        self._connect()  # fail fast on a bad address, like the old client
+
+    # ---- transport ----
+    def _connect(self) -> None:
+        sock = socket.create_connection(self._addr,
+                                        timeout=self._connect_timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(self._request_timeout_s)
+        self._sock = sock
+
+    def _drop_socket(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _roundtrip(self, method: str, args, kwargs):
+        if self._sock is None:
+            self._connect()
+            self._registry.counter("tracker_reconnects_total").inc()
+        _send_frame(self._sock, (method, args, kwargs))
+        return _recv_frame(self._sock)
 
     def _call(self, method: str, *args, **kwargs):
+        """One RPC with the retry policy. Any transport-layer failure —
+        timeout, reset, short/garbled frame — closes the socket; idempotent
+        methods then retry on a fresh connection, everything else surfaces
+        ``TrackerUnavailable`` immediately (see ``_IDEMPOTENT``)."""
+        attempts = (self._retries + 1) if method in _IDEMPOTENT else 1
+        last_exc: Optional[BaseException] = None
         with self._lock:
-            _send_frame(self._sock, (method, args, kwargs))
-            ok, result = _recv_frame(self._sock)
-        if not ok:
-            raise result
-        return result
+            for attempt in range(attempts):
+                if attempt:
+                    self._registry.counter("tracker_retries_total").inc()
+                    delay = min(self._max_backoff_s,
+                                self._backoff_s * (2 ** (attempt - 1)))
+                    time.sleep(delay * (0.5 + random.random() / 2))
+                try:
+                    ok, result = self._roundtrip(method, args, kwargs)
+                except (ConnectionError, socket.timeout, OSError, EOFError,
+                        struct.error, pickle.UnpicklingError) as exc:
+                    last_exc = exc
+                    self._drop_socket()  # broken frame ⇒ resync via reconnect
+                    continue
+                if not ok:
+                    raise result  # server-side exception, transport is fine
+                return result
+        self._registry.counter("tracker_failures_total").inc()
+        raise TrackerUnavailable(
+            f"tracker at {self._addr[0]}:{self._addr[1]} unavailable after "
+            f"{attempts} attempt(s) calling {method!r}: {last_exc!r}"
+        ) from last_exc
 
     def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        with self._lock:
+            self._drop_socket()
 
     # ---- workers ----
     def add_worker(self, worker_id):
@@ -251,6 +352,9 @@ class StateTrackerClient(StateTracker):
 
     def count(self, key):
         return self._call("count", key)
+
+    def counters_snapshot(self, prefix: str = ""):
+        return self._call("counters_snapshot", prefix)
 
     def finish(self):
         return self._call("finish")

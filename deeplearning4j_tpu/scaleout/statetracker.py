@@ -164,6 +164,14 @@ class InMemoryStateTracker(StateTracker):
         with self._lock:
             return self._counters[key]
 
+    def counters_snapshot(self, prefix: str = "") -> Dict[str, float]:
+        """All counters under ``prefix`` in one read — a remote poller
+        (elastic workers watching ``elastic.*``) pays one RPC instead of
+        one per key."""
+        with self._lock:
+            return {k: v for k, v in self._counters.items()
+                    if k.startswith(prefix)}
+
     def finish(self) -> None:
         with self._lock:
             self._done = True

@@ -1,8 +1,13 @@
 """Importable performer factories for distributed-runner worker processes
 (the worker CLI resolves "--performer module:factory" by import, so test
-performers must live in a real module, not a test function)."""
+performers must live in a real module, not a test function), plus the
+ISSUE-6 fault-injection harness: elastic model factories and the
+``FaultyTrackerProxy`` that delays / cuts / blackholes tracker frames."""
 
 import os
+import socket
+import struct
+import threading
 import time
 
 import numpy as np
@@ -54,3 +59,136 @@ class CrashAfterOnePerformer(AveragingPerformer):
 
 def crashing_performer() -> CrashAfterOnePerformer:
     return CrashAfterOnePerformer()
+
+
+# ------------------------------------------------------------- elastic ----
+
+def elastic_toy_model(**kwargs):
+    """Small deterministic ElasticModel for multi-process elastic tests —
+    resolvable by the elastic worker CLI as ``_dist_helpers:
+    elastic_toy_model``. Kwargs override the tiny defaults."""
+    from deeplearning4j_tpu.scaleout.elastic import SyntheticRegressionModel
+
+    defaults = dict(d_in=4, d_hidden=8, batch=8, lr=0.05, seed=0,
+                    mesh_devices=2)
+    defaults.update(kwargs)
+    return SyntheticRegressionModel(**defaults)
+
+
+# ------------------------------------------------------ fault injection ----
+
+_HDR = struct.Struct(">I")
+
+
+def _read_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _read_frame_bytes(sock):
+    hdr = _read_exact(sock, _HDR.size)
+    (n,) = _HDR.unpack(hdr)
+    return hdr + _read_exact(sock, n)
+
+
+class FaultyTrackerProxy:
+    """A frame-aware TCP proxy between ``StateTrackerClient``s and a real
+    ``StateTrackerServer`` — the deterministic fault injector for the
+    transport layer. Per request/response exchange it can:
+
+    - ``delay_s``: sleep before forwarding each request frame (latency).
+    - ``cut_response_after``: forward that many exchanges normally, then
+      send only HALF of the next response frame and close both sockets —
+      the client sees a broken frame mid-read and must reconnect
+      (one-shot: subsequent connections pass through cleanly).
+    - ``blackhole=True``: forward nothing and never respond — the client's
+      request timeout is the only way out.
+
+    Connect clients to ``proxy.address``; the proxy dials ``target``
+    per client connection.
+    """
+
+    def __init__(self, target_address: str, delay_s: float = 0.0,
+                 cut_response_after: int = None, blackhole: bool = False):
+        host, _, port = target_address.rpartition(":")
+        self._target = (host, int(port))
+        self.delay_s = delay_s
+        self.blackhole = blackhole
+        self._cut_remaining = cut_response_after
+        self._lock = threading.Lock()
+        self.exchanges = 0
+        self.cuts = 0
+        self._listener = socket.socket()
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(16)
+        self._closing = False
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    @property
+    def address(self) -> str:
+        host, port = self._listener.getsockname()
+        return f"{host}:{port}"
+
+    def _accept_loop(self):
+        while not self._closing:
+            try:
+                client, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._pump, args=(client,),
+                             daemon=True).start()
+
+    def _pump(self, client):
+        try:
+            upstream = socket.create_connection(self._target, timeout=10)
+        except OSError:
+            client.close()
+            return
+        try:
+            while True:
+                request = _read_frame_bytes(client)
+                if self.delay_s:
+                    time.sleep(self.delay_s)
+                if self.blackhole:
+                    continue  # swallow: the client request times out
+                upstream.sendall(request)
+                response = _read_frame_bytes(upstream)
+                cut = False
+                with self._lock:
+                    self.exchanges += 1
+                    if self._cut_remaining is not None:
+                        if self._cut_remaining <= 0:
+                            self._cut_remaining = None
+                            self.cuts += 1
+                            cut = True
+                        else:
+                            self._cut_remaining -= 1
+                if cut:
+                    client.sendall(response[: max(1, len(response) // 2)])
+                    return  # broken frame: close both mid-response
+                client.sendall(response)
+        except (ConnectionError, OSError):
+            return
+        finally:
+            client.close()
+            upstream.close()
+
+    def close(self):
+        self._closing = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -172,3 +172,43 @@ def test_bench_skips_stages_past_deadline():
         v == "skipped_budget"
         for k, v in rec["detail"].items() if k.endswith("_status")
     )
+
+
+def test_bench_fault_tolerance_stages_on_cpu():
+    """The ISSUE-6 robustness stages run end to end on the CPU backend:
+    ``ckpt_async`` reports save-step jitter for blocking vs background
+    snapshots (background overhead must not exceed blocking — the whole
+    point of the writer thread), and ``elastic_sync`` reports the SparkNet
+    sync-period A/B (held-out loss + steps/s for sync_every ∈ {1,8,32})."""
+    env = dict(os.environ)
+    env["BENCH_FORCE_CPU"] = "1"
+    env["BENCH_FAST"] = "1"
+    env["BENCH_BUDGET_SEC"] = "300"
+    env["BENCH_ONLY"] = "ckpt_async,elastic_sync"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=360, cwd=REPO, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    det = json.loads(out.stdout.strip().splitlines()[-1])["detail"]
+
+    assert det.get("ckpt_async_blocking_vs_background"), det.get(
+        "ckpt_async_status")
+    ca = det.get("ckpt_async_detail", {})
+    assert ca["blocking"]["save_step_ms"] > 0
+    assert ca["background"]["plain_step_ms"] > 0
+    # the background writer must take (at least) no MORE off the training
+    # thread than a blocking save; on any real disk it takes far less
+    assert (ca["background"]["save_overhead_ms"]
+            <= ca["blocking"]["save_overhead_ms"] + 1.0), ca
+
+    assert det.get("elastic_sync_steps_per_sec"), det.get(
+        "elastic_sync_status")
+    es = det.get("elastic_sync_detail", {})
+    per = es["per_sync_every"]
+    assert set(per) == {"1", "8", "32"}
+    for cfg in per.values():
+        assert cfg["final_eval_loss"] > 0
+        assert cfg["steps_per_sec"] > 0
+    # infrequent sync is faster wall-clock (fewer averaging barriers)
+    assert per["32"]["steps_per_sec"] >= per["1"]["steps_per_sec"], per

@@ -432,3 +432,54 @@ def test_rng_stream_resume_through_subsystem(tmp_path, flavor):
     np.testing.assert_allclose(tail_c, tail_a, atol=ATOL, rtol=0)
     np.testing.assert_allclose(np.asarray(net_a.params()),
                                np.asarray(net_c.params()), atol=ATOL)
+
+
+# ------------------------------------------- crash mid-manifest-merge ----
+
+def test_master_crash_mid_manifest_merge_resumes_prior_step(tmp_path):
+    """Acceptance (c): a multi-host save killed between the per-host shard
+    writes and the coordinator's manifest commit leaves NO committed
+    manifest — ``latest_step()`` still answers the previous step, the
+    restore from it is byte-clean, and the interrupted directory (shards +
+    part manifests) is swept once a newer step commits."""
+    import os
+
+    from deeplearning4j_tpu.scaleout.ckpt import save_process_shards
+    from deeplearning4j_tpu.scaleout.ckpt.manifest import (
+        list_part_manifests,
+        step_dir_name,
+    )
+
+    mesh = _dp_ep_mesh()
+    capacity = (B // 2) * T
+    step = make_composed_train_step(mesh, H, capacity)
+    p = shard_lm_params(_params(), mesh)
+    for i in range(3):
+        tk, tg = shard_lm_batch(*_step_data(i), mesh)
+        p, loss = step(p, tk, tg)
+        jax.block_until_ready(loss)
+    ck = _ck(tmp_path)
+    ck.save(3, {"params": p}, mesh=mesh)
+    p3 = jax.tree_util.tree_map(np.asarray, jax.device_get(p))
+
+    # step 4's save: every host wrote its shards + part manifest, but the
+    # coordinator CRASHED before merge_save — no MANIFEST.json ever lands
+    tk, tg = shard_lm_batch(*_step_data(3), mesh)
+    p, _ = step(p, tk, tg)
+    interrupted = save_process_shards(str(tmp_path), 4, {"params": p},
+                                      process_index=0)
+    assert list_part_manifests(interrupted), "parts should exist"
+    # (no merge happens — the simulated crash point)
+
+    assert ck.latest_step() == 3  # the interrupted save is invisible
+    template = {"params": _params()}
+    shardings = {"params": lm_param_shardings(template["params"], mesh)}
+    state, resumed_step, _ = ck.restore(template, shardings)
+    assert resumed_step == 3
+    _assert_close(state["params"], p3, "resume skips the interrupted save",
+                  atol=0.0)
+
+    # a later committed save supersedes and sweeps the debris
+    ck.save(5, {"params": p}, mesh=mesh)
+    assert not os.path.isdir(os.path.join(str(tmp_path), step_dir_name(4)))
+    assert ck.latest_step() == 5

@@ -1,0 +1,414 @@
+"""ISSUE 6 fault matrix: elastic parameter-averaging training under
+injected failures, plus the hardened tracker transport.
+
+The multi-process tests spawn REAL worker OS processes through the elastic
+worker CLI and compare the master's final averaged params against
+``simulate_elastic`` — an in-process oracle that replays the identical
+round protocol (same adoption, same local-step indexing, same
+``average_trees`` float64 math), so survivor-set parity bounds are
+checkpoint-grade (1e-6), not statistical.
+
+Split: one fast kill/recover smoke stays in tier-1; the wider matrix
+(post-contribution kill, rejoin, staleness run-ahead) is ``slow``. Every
+subprocess wait carries an explicit timeout so a wedged cluster fails the
+test instead of hanging CI.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import _dist_helpers
+from deeplearning4j_tpu.scaleout.elastic import (
+    VERSION_KEY,
+    ElasticMaster,
+    ElasticWorker,
+    _contrib_key,
+    simulate_elastic,
+)
+from deeplearning4j_tpu.scaleout.remote_tracker import (
+    StateTrackerClient,
+    StateTrackerServer,
+    TrackerUnavailable,
+)
+from deeplearning4j_tpu.telemetry.registry import MetricsRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TESTS = os.path.join(REPO, "tests")
+
+SYNC = 3
+
+
+def _model(**kw):
+    return _dist_helpers.elastic_toy_model(**kw)
+
+
+def _spawn_worker(address, blob_uri, worker_id, seed, sync_every=SYNC,
+                  extra=()):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        f"{REPO}{os.pathsep}{TESTS}{os.pathsep}" + env.get("PYTHONPATH", ""))
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [sys.executable, "-m", "deeplearning4j_tpu.scaleout.elastic",
+           "--connect", address, "--blob", blob_uri,
+           "--model", "_dist_helpers:elastic_toy_model",
+           "--worker-id", worker_id, "--worker-seed", str(seed),
+           "--sync-every", str(sync_every), "--round-timeout-s", "90",
+           *extra]
+    return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+
+def _finish(procs, master, timeout=120):
+    outs = []
+    try:
+        for p in procs:
+            try:
+                outs.append(p.communicate(timeout=timeout))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                outs.append(p.communicate())
+    finally:
+        master.shutdown()
+    return outs
+
+
+def _assert_tree_close(a, b, atol, what):
+    import jax
+
+    for la, lb in zip(jax.tree_util.tree_leaves(a),
+                      jax.tree_util.tree_leaves(b)):
+        err = float(np.max(np.abs(np.asarray(la) - np.asarray(lb))))
+        assert err <= atol, f"{what}: leaf diff {err}"
+
+
+def _worker_summary(out: str, worker_id: str) -> dict:
+    lines = [ln for ln in out.splitlines()
+             if ln.startswith("ELASTIC_WORKER_DONE")]
+    assert lines, f"no completion line from {worker_id}: {out[-500:]}"
+    return json.loads(lines[-1].split(None, 1)[1])
+
+
+# ---------------------------------------------------- kill -9 mid-round ----
+
+def test_elastic_kill_recover_smoke(tmp_path):
+    """Tier-1 smoke for acceptance (a): one of two REAL worker processes
+    hard-exits mid-round (before publishing — its delta is unsynced), the
+    master deregisters it on heartbeat staleness and commits every round
+    on the survivor set. Final averaged params match the survivor-set
+    oracle to 1e-6 and ``workers_failed`` is incremented."""
+    blob = f"file://{tmp_path / 'blob'}"
+    master = ElasticMaster(_model(), blob, sync_every=SYNC, min_workers=1,
+                           worker_timeout_s=2.0, register_timeout_s=120,
+                           round_timeout_s=120)
+    procs = [
+        _spawn_worker(master.address, blob, "survivor", seed=1),
+        _spawn_worker(master.address, blob, "victim", seed=2,
+                      extra=["--crash-at-round", "0",
+                             "--crash-after-steps", "1"]),
+    ]
+    try:
+        master.wait_for_workers(2)  # both registered before the kill lands
+        final = master.train(rounds=3)
+    finally:
+        outs = _finish(procs, master)
+    assert procs[1].returncode == 23, outs[1][1][-500:]  # the os._exit mark
+    assert master.tracker.count("workers_failed") == 1
+    assert "victim" not in master.tracker.workers()
+    assert int(master.tracker.count(VERSION_KEY)) == 3
+    ref, _ = simulate_elastic(_model(), [1], sync_every=SYNC, rounds=3)
+    _assert_tree_close(final, ref, 1e-6, "survivor-set parity")
+    # the survivor exited cleanly on the done flag, not by being killed
+    assert procs[0].returncode == 0, outs[0][1][-500:]
+
+
+@pytest.mark.slow
+def test_elastic_kill_after_contributing_keeps_synced_work(tmp_path):
+    """The DeepSpark cost model, pinned: a worker that dies in round 1
+    loses ONLY its unsynced round-1 delta — its round-0 contribution stays
+    in the average. Oracle: both workers contribute round 0, survivor only
+    from round 1 on."""
+    blob = f"file://{tmp_path / 'blob'}"
+    master = ElasticMaster(_model(), blob, sync_every=SYNC, min_workers=1,
+                           worker_timeout_s=2.0, register_timeout_s=120,
+                           round_timeout_s=120)
+    procs = [
+        _spawn_worker(master.address, blob, "survivor", seed=1),
+        _spawn_worker(master.address, blob, "victim", seed=2,
+                      extra=["--crash-at-round", "1",
+                             "--crash-after-steps", "2"]),
+    ]
+    try:
+        master.wait_for_workers(2)
+        final = master.train(rounds=4)
+    finally:
+        outs = _finish(procs, master)
+    assert procs[1].returncode == 23, outs[1][1][-500:]
+    assert master.tracker.count("workers_failed") == 1
+    # seeds [survivor=1, victim=2]; round 0 both, then survivor alone
+    ref, _ = simulate_elastic(
+        _model(), [1, 2], sync_every=SYNC, rounds=4,
+        schedule={0: [0, 1], 1: [0], 2: [0], 3: [0]})
+    _assert_tree_close(final, ref, 1e-6, "synced-work-kept parity")
+
+
+# ------------------------------------------------------------- rejoin ----
+
+@pytest.mark.slow
+def test_elastic_rejoin_readmitted_at_current_step(tmp_path):
+    """Acceptance (b): a replacement worker that connects mid-run pulls
+    the current averaged params + step and is admitted from the current
+    round — barriers for earlier rounds never waited for it, and its local
+    step counter continues from ``version * sync_every``. Phase 1 loses
+    the victim to a kill -9; the replacement joins before phase 2, which
+    then cannot commit a single round without its contributions."""
+    blob = f"file://{tmp_path / 'blob'}"
+    master = ElasticMaster(_model(), blob, sync_every=SYNC, min_workers=1,
+                           worker_timeout_s=2.0, register_timeout_s=120,
+                           round_timeout_s=120)
+    procs = [
+        _spawn_worker(master.address, blob, "original", seed=1),
+        _spawn_worker(master.address, blob, "victim", seed=2,
+                      extra=["--crash-at-round", "1"]),
+    ]
+    try:
+        master.wait_for_workers(2)
+        master.train(rounds=3, finish=False)  # phase 1: victim dies here
+        assert master.tracker.count("workers_failed") == 1
+        # mid-run join: the replacement adopts version 3's params + step
+        procs.append(_spawn_worker(master.address, blob, "replacement",
+                                   seed=3))
+        deadline = time.monotonic() + 90
+        while "replacement" not in master.tracker.workers():
+            assert time.monotonic() < deadline, "replacement never joined"
+            time.sleep(0.05)
+        master.train(rounds=3)  # phase 2: barriers now REQUIRE it
+    finally:
+        outs = _finish(procs, master)
+    assert int(master.tracker.count(VERSION_KEY)) == 6
+    summary = _worker_summary(outs[-1][0], "replacement")
+    admit = int(master.tracker.count("admit.replacement"))
+    assert admit == 3, admit  # admitted at the version it adopted
+    assert summary["round"] >= admit
+    assert summary["step"] == summary["round"] * SYNC  # step taken over
+    assert master.tracker.count("elastic.joined") >= 1
+    assert procs[-1].returncode == 0, outs[-1][1][-500:]
+    # every phase-2 round carries a replacement contribution
+    for rnd in range(3, 6):
+        assert master.tracker.count(f"contrib.{rnd}.replacement") > 0, rnd
+
+
+# -------------------------------------------------- staleness run-ahead ----
+
+@pytest.mark.slow
+def test_elastic_staleness_runs_ahead_of_commits(tmp_path):
+    """DeepSpark staleness knob: with ``max_staleness=2`` a worker keeps
+    training on its local chain while the master is NOT committing at all,
+    publishing contributions up to two rounds ahead; with the default
+    bulk-synchronous setting it parks after one. Then the master starts
+    committing and the run completes."""
+    blob = f"file://{tmp_path / 'blob'}"
+    master = ElasticMaster(_model(), blob, sync_every=2, min_workers=1,
+                           worker_timeout_s=30.0, register_timeout_s=60,
+                           round_timeout_s=90)
+    worker = ElasticWorker(master.address, blob, _model(),
+                           worker_id="stale", worker_seed=5, sync_every=2,
+                           max_staleness=2, round_timeout_s=90)
+    t = threading.Thread(target=worker.run, daemon=True)
+    t.start()
+    try:
+        master.wait_for_workers(1)
+        # master commits NOTHING yet; the worker still publishes rounds
+        # 0..2 (a 2-round lead past adopted version 0), then blocks
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if all(master.blob.try_get(_contrib_key(r, "stale")) is not None
+                   for r in range(3)):
+                break
+            time.sleep(0.05)
+        for r in range(3):
+            assert master.blob.try_get(_contrib_key(r, "stale")) is not None
+        # lead is capped: round 3 must NOT be published while version is 0
+        time.sleep(0.5)
+        assert master.blob.try_get(_contrib_key(3, "stale")) is None
+        final = master.train(rounds=4)
+        assert final is not None
+    finally:
+        master.shutdown()
+    t.join(timeout=60)
+    assert not t.is_alive(), "stale worker failed to finish"
+
+
+# ----------------------------------------------------- min_workers halt ----
+
+def test_elastic_min_workers_halts_below_quorum(tmp_path):
+    """Degrade-vs-halt: with ``min_workers=2`` the loss of one of two
+    workers is a loud ElasticTrainingError, not silent degraded training."""
+    from deeplearning4j_tpu.scaleout.elastic import ElasticTrainingError
+
+    blob = f"file://{tmp_path / 'blob'}"
+    master = ElasticMaster(_model(), blob, sync_every=SYNC, min_workers=2,
+                           worker_timeout_s=1.5, register_timeout_s=120,
+                           round_timeout_s=60)
+    procs = [
+        _spawn_worker(master.address, blob, "w0", seed=1),
+        _spawn_worker(master.address, blob, "crash", seed=2,
+                      extra=["--crash-at-round", "0"]),
+    ]
+    try:
+        master.wait_for_workers(2)
+        with pytest.raises(ElasticTrainingError, match="min_workers"):
+            master.train(rounds=4)
+    finally:
+        _finish(procs, master)
+    assert master.tracker.count("workers_failed") == 1
+
+
+# ------------------------------------------------------------ transport ----
+
+def test_tracker_blackhole_times_out_as_unavailable():
+    """A master that accepts but never answers used to hang the worker
+    thread forever in ``recv``; now the request timeout surfaces
+    TrackerUnavailable after the bounded retry budget."""
+    with StateTrackerServer() as server:
+        with _dist_helpers.FaultyTrackerProxy(server.address,
+                                              blackhole=True) as proxy:
+            client = StateTrackerClient(proxy.address,
+                                        request_timeout_s=0.3, retries=1,
+                                        backoff_s=0.01,
+                                        registry=MetricsRegistry())
+            t0 = time.monotonic()
+            with pytest.raises(TrackerUnavailable):
+                client.workers()
+            assert time.monotonic() - t0 < 5.0  # bounded, not forever
+            client.close()
+
+
+def test_tracker_reconnects_through_cut_frame():
+    """A response frame cut in half mid-stream (master restart / dropped
+    proxy) is absorbed: the client reconnects and transparently retries
+    the idempotent call; the reconnect is visible in telemetry."""
+    reg = MetricsRegistry()
+    with StateTrackerServer() as server:
+        with _dist_helpers.FaultyTrackerProxy(
+                server.address, cut_response_after=2) as proxy:
+            client = StateTrackerClient(proxy.address, request_timeout_s=5,
+                                        retries=3, backoff_s=0.01,
+                                        registry=reg)
+            client.add_worker("w0")                 # exchange 1
+            assert client.workers() == ["w0"]       # exchange 2
+            # exchange 3's response is cut mid-frame → reconnect + retry
+            assert client.workers() == ["w0"]
+            assert proxy.cuts == 1
+            assert reg.counter("tracker_reconnects_total").value >= 1
+            assert reg.counter("tracker_retries_total").value >= 1
+            client.close()
+
+
+def test_tracker_delay_within_timeout_is_just_latency():
+    with StateTrackerServer() as server:
+        with _dist_helpers.FaultyTrackerProxy(server.address,
+                                              delay_s=0.05) as proxy:
+            client = StateTrackerClient(proxy.address, request_timeout_s=2,
+                                        registry=MetricsRegistry())
+            client.increment("k", 2.0)
+            assert client.count("k") == 2.0
+            client.close()
+
+
+def test_tracker_non_idempotent_fails_fast_without_retry():
+    """``increment`` through a dead connection must raise rather than
+    silently retry: re-applying after an ambiguous failure could double
+    count. (Idempotent calls on the same dead client DO retry and fail
+    only after the budget.)"""
+    reg = MetricsRegistry()
+    server = StateTrackerServer()
+    client = StateTrackerClient(server.address, request_timeout_s=0.5,
+                                retries=2, backoff_s=0.01, registry=reg)
+    server.shutdown()
+    with pytest.raises(TrackerUnavailable):
+        client.increment("jobs_done")
+    assert reg.counter("tracker_retries_total").value == 0
+    with pytest.raises(TrackerUnavailable):
+        client.workers()
+    assert reg.counter("tracker_retries_total").value >= 1
+    client.close()
+
+
+@pytest.mark.slow
+def test_elastic_worker_survives_tracker_frame_cut(tmp_path):
+    """End to end through the fault proxy: a mid-run cut connection is a
+    stall for the elastic worker (reconnect + idempotent retry inside the
+    client), not a crash — training completes with full parity."""
+    blob = f"file://{tmp_path / 'blob'}"
+    master = ElasticMaster(_model(), blob, sync_every=SYNC, min_workers=1,
+                           worker_timeout_s=30.0, register_timeout_s=60,
+                           round_timeout_s=90)
+    with _dist_helpers.FaultyTrackerProxy(master.address,
+                                          cut_response_after=10) as proxy:
+        worker = ElasticWorker(proxy.address, blob, _model(),
+                               worker_id="wobbly", worker_seed=4,
+                               sync_every=SYNC, round_timeout_s=90)
+        t = threading.Thread(target=worker.run, daemon=True)
+        t.start()
+        try:
+            master.wait_for_workers(1)
+            final = master.train(rounds=4)
+        finally:
+            master.shutdown()
+        t.join(timeout=60)
+        assert not t.is_alive()
+        assert proxy.cuts == 1  # the fault actually fired
+    ref, _ = simulate_elastic(_model(), [4], sync_every=SYNC, rounds=4)
+    _assert_tree_close(final, ref, 1e-6, "parity through frame cut")
+
+
+# -------------------------------------------------- checkpoint the run ----
+
+def test_elastic_master_checkpoints_and_resumes(tmp_path):
+    """The master snapshots averaged params through the (async) ckpt
+    subsystem and a FRESH master resumes at the committed version — the
+    elastic analogue of kill/resume parity."""
+    from deeplearning4j_tpu.scaleout.ckpt import (
+        AsyncCheckpointer,
+        Checkpointer,
+    )
+
+    blob = f"file://{tmp_path / 'blob'}"
+    reg = MetricsRegistry()
+    ck = AsyncCheckpointer(Checkpointer(str(tmp_path / "ckpt"), keep_last=3,
+                                        registry=reg))
+    master = ElasticMaster(_model(), blob, sync_every=SYNC, min_workers=1,
+                           worker_timeout_s=30.0, register_timeout_s=60,
+                           round_timeout_s=90, checkpointer=ck,
+                           checkpoint_every=2)
+    worker = ElasticWorker(master.address, blob, _model(), worker_id="w",
+                           worker_seed=9, sync_every=SYNC, round_timeout_s=90)
+    t = threading.Thread(target=worker.run, daemon=True)
+    t.start()
+    try:
+        master.wait_for_workers(1)
+        final = master.train(rounds=4)
+    finally:
+        master.shutdown()  # flushes pending async saves
+    t.join(timeout=60)
+    assert reg.counter("ckpt_async_saves_total").value >= 2
+    assert reg.counter("ckpt_async_failures_total").value == 0
+
+    blob2 = f"file://{tmp_path / 'blob2'}"
+    master2 = ElasticMaster(_model(), blob2, sync_every=SYNC,
+                            checkpointer=Checkpointer(
+                                str(tmp_path / "ckpt"), registry=reg))
+    try:
+        resumed = master2.resume()
+        assert resumed == 4
+        _assert_tree_close(master2.params(), final, 1e-7,
+                           "resumed elastic params")
+    finally:
+        master2.shutdown()

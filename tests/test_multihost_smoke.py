@@ -206,6 +206,85 @@ print(f"RINGOK {pid}", flush=True)
 """
 
 
+_CKPT_CHILD = r"""
+import os, sys
+import os as _os
+_os.environ["XLA_FLAGS"] = (_os.environ.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=2")
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:
+    pass  # 0.4.x: the XLA flag above already did it
+sys.path.insert(0, os.environ["DL4J_REPO"])
+
+from deeplearning4j_tpu.parallel import multihost
+
+multihost.initialize()
+pid, n = multihost.process_info()
+assert n == 2
+
+import time
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.scaleout.ckpt import (
+    restore_sharded,
+    save_process_shards,
+    merge_process_manifests,
+    latest_step,
+)
+from deeplearning4j_tpu.scaleout.ckpt.manifest import read_manifest
+
+root = os.environ["DL4J_CKPT_ROOT"]
+mesh = multihost.global_mesh(("data",))
+assert len(mesh.devices.ravel()) == 4
+
+# a global array sharded across BOTH processes' devices + a replicated one
+x_np = np.arange(32.0, dtype=np.float32).reshape(8, 4)
+shard = NamedSharding(mesh, P("data"))
+rep = NamedSharding(mesh, P())
+b_np = np.arange(4, dtype=np.float32)
+x = jax.make_array_from_callback(x_np.shape, shard, lambda idx: x_np[idx])
+b = jax.make_array_from_callback(b_np.shape, rep, lambda idx: b_np[idx])
+state = {"x": x, "b": b}
+
+# EVERY process writes only its addressable shards
+step_dir = save_process_shards(root, 11, state)
+if multihost.is_coordinator():
+    # the directory is not a checkpoint until the coordinator merges
+    assert latest_step(root) is None
+    # merge_process_manifests IS the barrier: it waits for both parts
+    merge_process_manifests(root, 11, n_processes=2,
+                            meta={"src": "mh-child"}, mesh=mesh)
+else:
+    # non-coordinators wait for the committed manifest on the shared root
+    # (this jax build has no multiprocess CPU collectives to sync with)
+    deadline = time.monotonic() + 120
+    while latest_step(root) != 11:
+        assert time.monotonic() < deadline, "manifest never committed"
+        time.sleep(0.05)
+assert latest_step(root) == 11
+
+m = read_manifest(step_dir)
+n_chunks = sum(len(e.chunks) for e in m.leaves)
+assert n_chunks == 4 + 1, n_chunks  # 4 data shards + 1 deduped replica
+
+template = {"x": np.zeros((8, 4), np.float32), "b": np.zeros(4, np.float32)}
+shardings = {"x": shard, "b": rep}
+got, manifest = restore_sharded(step_dir, template, shardings)
+assert manifest.meta["src"] == "mh-child"
+for s in got["x"].addressable_shards:
+    np.testing.assert_array_equal(np.asarray(s.data), x_np[s.index])
+for s in got["b"].addressable_shards:
+    np.testing.assert_array_equal(np.asarray(s.data),
+                                  np.arange(4, dtype=np.float32))
+print(f"MHCKPT {pid}", flush=True)
+"""
+
+
 def _free_port() -> int:
     import socket
 
@@ -274,6 +353,36 @@ def test_two_process_dp_training_matches_single_process(tmp_path):
         lines.append(line[0].split(None, 2)[2])
     # both controllers observed identical global scores
     assert lines[0] == lines[1], lines
+
+
+@pytest.mark.slow
+def test_two_process_per_host_checkpoint_write_and_merge(tmp_path):
+    """ISSUE 6 tentpole persistence layer, on a REAL two-process mesh:
+    each host writes only its addressable shards (lowest-global-device-id
+    dedup for replicas), the coordinator merges the part manifests behind
+    the barrier and commits LAST, and both hosts restore the committed
+    step without ever materializing global state on one host."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    port = _free_port()
+    root = str(tmp_path / "ckpt")
+    procs = []
+    for pid in range(2):
+        env = dict(
+            os.environ,
+            DL4J_REPO=repo,
+            DL4J_CKPT_ROOT=root,
+            DL4J_COORDINATOR=f"127.0.0.1:{port}",
+            DL4J_NUM_PROCESSES="2",
+            DL4J_PROCESS_ID=str(pid),
+            JAX_PLATFORMS="cpu",
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _CKPT_CHILD], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    for pid, p in enumerate(procs):
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, f"proc {pid} failed:\n{err[-2000:]}"
+        assert f"MHCKPT {pid}" in out
 
 
 @pytest.mark.slow

@@ -26,6 +26,7 @@ import numpy as np  # noqa: E402
 
 from deeplearning4j_tpu.scaleout.ckpt.manifest import (  # noqa: E402
     has_manifest,
+    list_part_manifests,
     read_manifest,
 )
 from deeplearning4j_tpu.scaleout.ckpt.reshard import (  # noqa: E402
@@ -42,9 +43,12 @@ def resolve_step_dir(path: str) -> str:
         return path
     step_dir = latest_step_dir(path)
     if step_dir is None:
+        parts = list_part_manifests(path)
+        hint = (f" ({len(parts)} part manifest(s) present — a multi-host "
+                "save whose coordinator never merged)") if parts else ""
         raise FileNotFoundError(
             f"{path}: no committed checkpoint (a directory without a "
-            "MANIFEST.json is an interrupted save, not a checkpoint)")
+            f"MANIFEST.json is an interrupted save, not a checkpoint){hint}")
     return step_dir
 
 
