@@ -32,10 +32,13 @@ half-checkpoint a resume could pick up.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import queue
 import threading
 from typing import Dict, Optional
+
+from deeplearning4j_tpu.telemetry import trace as _trace
 
 log = logging.getLogger(__name__)
 
@@ -82,7 +85,11 @@ class AsyncCheckpointer:
         buffers are full."""
         reg, p = self.registry, self.prefix
         _start_host_copies(state)
-        item = (int(step), state, dict(meta or {}), mesh)
+        # the enqueuer's span context rides the queue item so the writer
+        # thread's ``ckpt.async_write`` span parents under the training-
+        # side operation that requested the snapshot (cross-thread link)
+        item = (int(step), state, dict(meta or {}), mesh,
+                _trace.current_trace_context())
         if self._queue.full():
             reg.counter(f"{p}_async_backpressure").inc()
         self._queue.put(item)
@@ -102,9 +109,16 @@ class AsyncCheckpointer:
             if item is _SENTINEL:
                 self._queue.task_done()
                 return
-            step, state, meta, mesh = item
+            step, state, meta, mesh, ctx = item
+            tracer = _trace.get_tracer()
             try:
-                self._ck.save(step, state, meta=meta, mesh=mesh)
+                write_cm = (tracer.span("ckpt.async_write",
+                                        parent=ctx or False,
+                                        attrs={"step": step})
+                            if tracer is not None
+                            else contextlib.nullcontext())
+                with write_cm:
+                    self._ck.save(step, state, meta=meta, mesh=mesh)
                 reg.counter(f"{p}_async_saves_total").inc()
             except BaseException as exc:  # surfaced at flush()/close()
                 with self._error_lock:

@@ -32,6 +32,7 @@ from deeplearning4j_tpu.scaleout.ckpt.reshard import (
     verify_checksums,
 )
 from deeplearning4j_tpu.scaleout.ckpt.sharded_io import save_sharded
+from deeplearning4j_tpu.telemetry import trace as _trace
 
 log = logging.getLogger(__name__)
 
@@ -71,20 +72,26 @@ class Checkpointer:
     def save(self, step: int, state, meta: Optional[Dict] = None,
              mesh=None) -> str:
         reg, p = self.registry, self.prefix
-        t0 = time.perf_counter()
-        step_dir = save_sharded(self.root, step, state, meta=meta, mesh=mesh)
-        # graftlint: allow[untimed-dispatch] save_sharded fetches every shard via np.asarray (host-synchronous IO); nothing is left enqueued when the clock stops
-        save_ms = (time.perf_counter() - t0) * 1000.0
-        manifest = mf.read_manifest(step_dir)
-        n_chunks = sum(len(e.chunks) for e in manifest.leaves)
-        reg.counter(f"{p}_saves_total").inc()
-        reg.counter(f"{p}_bytes_total").inc(float(manifest.total_bytes))
-        reg.histogram(f"{p}_save_ms").observe(save_ms)
-        reg.gauge(f"{p}_last_step").set(float(step))
-        reg.gauge(f"{p}_last_bytes").set(float(manifest.total_bytes))
-        reg.gauge(f"{p}_last_shards").set(float(n_chunks))
-        self.gc()
-        return step_dir
+        with _trace.maybe_span("ckpt.save",
+                               attrs={"step": int(step)}) as sp:
+            t0 = time.perf_counter()
+            step_dir = save_sharded(self.root, step, state, meta=meta,
+                                    mesh=mesh)
+            # graftlint: allow[untimed-dispatch] save_sharded fetches every shard via np.asarray (host-synchronous IO); nothing is left enqueued when the clock stops
+            save_ms = (time.perf_counter() - t0) * 1000.0
+            manifest = mf.read_manifest(step_dir)
+            n_chunks = sum(len(e.chunks) for e in manifest.leaves)
+            reg.counter(f"{p}_saves_total").inc()
+            reg.counter(f"{p}_bytes_total").inc(float(manifest.total_bytes))
+            reg.histogram(f"{p}_save_ms").observe(save_ms)
+            reg.gauge(f"{p}_last_step").set(float(step))
+            reg.gauge(f"{p}_last_bytes").set(float(manifest.total_bytes))
+            reg.gauge(f"{p}_last_shards").set(float(n_chunks))
+            if sp is not None:
+                sp.set_attr("bytes", int(manifest.total_bytes))
+                sp.set_attr("chunks", int(n_chunks))
+            self.gc()
+            return step_dir
 
     def maybe_save(self, step: int, state_fn: Callable[[], object],
                    save_every: int, meta: Optional[Dict] = None,
@@ -119,19 +126,22 @@ class Checkpointer:
         )
 
         reg, p = self.registry, self.prefix
-        t0 = time.perf_counter()
-        step_dir = merge_process_manifests(
-            self.root, step, n_processes, meta=meta, mesh=mesh, state=state,
-            timeout_s=timeout_s)
-        # graftlint: allow[untimed-dispatch] merge is pure host IO (part-manifest JSON + rename); nothing device-side is in flight
-        merge_ms = (time.perf_counter() - t0) * 1000.0
-        manifest = mf.read_manifest(step_dir)
-        reg.counter(f"{p}_saves_total").inc()
-        reg.counter(f"{p}_bytes_total").inc(float(manifest.total_bytes))
-        reg.histogram(f"{p}_save_ms").observe(merge_ms)
-        reg.gauge(f"{p}_last_step").set(float(step))
-        self.gc()
-        return step_dir
+        with _trace.maybe_span("ckpt.merge_save",
+                               attrs={"step": int(step),
+                                      "n_processes": int(n_processes)}):
+            t0 = time.perf_counter()
+            step_dir = merge_process_manifests(
+                self.root, step, n_processes, meta=meta, mesh=mesh,
+                state=state, timeout_s=timeout_s)
+            # graftlint: allow[untimed-dispatch] merge is pure host IO (part-manifest JSON + rename); nothing device-side is in flight
+            merge_ms = (time.perf_counter() - t0) * 1000.0
+            manifest = mf.read_manifest(step_dir)
+            reg.counter(f"{p}_saves_total").inc()
+            reg.counter(f"{p}_bytes_total").inc(float(manifest.total_bytes))
+            reg.histogram(f"{p}_save_ms").observe(merge_ms)
+            reg.gauge(f"{p}_last_step").set(float(step))
+            self.gc()
+            return step_dir
 
     # ---------------------------------------------------------- restore ----
     def latest_step(self) -> Optional[int]:
@@ -174,20 +184,23 @@ class Checkpointer:
         structure, resharded onto the target ``shardings``. Returns
         ``(state, step, meta)``."""
         reg, p = self.registry, self.prefix
-        step_dir = self._dir_for(step)
-        if self.verify_on_restore:
-            problems = verify_checksums(step_dir)
-            if problems:
-                raise ValueError(
-                    f"checkpoint {step_dir} failed checksum verification: "
-                    + "; ".join(problems))
-        t0 = time.perf_counter()
-        state, manifest = restore_sharded(step_dir, template, shardings)
-        # graftlint: allow[untimed-dispatch] restore assembles host chunks synchronously (np.load + copies); device placement is fenced by callers
-        restore_ms = (time.perf_counter() - t0) * 1000.0
-        reg.histogram(f"{p}_restore_ms").observe(restore_ms)
-        reg.counter(f"{p}_restores_total").inc()
-        return state, manifest.step, dict(manifest.meta or {})
+        with _trace.maybe_span("ckpt.restore") as sp:
+            step_dir = self._dir_for(step)
+            if self.verify_on_restore:
+                problems = verify_checksums(step_dir)
+                if problems:
+                    raise ValueError(
+                        f"checkpoint {step_dir} failed checksum "
+                        "verification: " + "; ".join(problems))
+            t0 = time.perf_counter()
+            state, manifest = restore_sharded(step_dir, template, shardings)
+            # graftlint: allow[untimed-dispatch] restore assembles host chunks synchronously (np.load + copies); device placement is fenced by callers
+            restore_ms = (time.perf_counter() - t0) * 1000.0
+            reg.histogram(f"{p}_restore_ms").observe(restore_ms)
+            reg.counter(f"{p}_restores_total").inc()
+            if sp is not None:
+                sp.set_attr("step", int(manifest.step))
+            return state, manifest.step, dict(manifest.meta or {})
 
     def restore_net(self, step: Optional[int] = None):
         """Rebuild a MultiLayerNetwork from a net-state checkpoint (one

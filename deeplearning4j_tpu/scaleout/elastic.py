@@ -39,11 +39,24 @@ Persistence: the master checkpoints the averaged params through
 ``scaleout.ckpt`` (optionally via ``AsyncCheckpointer`` so snapshots stay
 off the training/aggregation thread) and ``resume()`` restarts from the
 latest committed version.
+
+Tracing (ISSUE 7): with a process tracer configured (``telemetry.trace``;
+the worker CLI's ``--trace-dir``, or ``ElasticMaster(trace_dir=...)``),
+the round protocol is spanned end to end — master ``elastic.round`` /
+``elastic.barrier`` (contribution arrivals as events) / ``elastic.average``,
+worker ``worker.round`` → ``worker.steps`` / ``worker.publish`` /
+``worker.sync_wait`` — and the master's round-span context rides every
+published global blob's meta, so worker spans parent under the master
+round that collects them: one trace tree across K+1 processes. Both sides
+dump the flight recorder on ``ElasticTrainingError`` and checkpoint it at
+round boundaries, so even a kill -9 leaves the previous boundary's dump
+plus begin-records for the spans that were open when the process died.
 """
 
 from __future__ import annotations
 
 import argparse
+import contextlib
 import importlib
 import io
 import json
@@ -61,6 +74,7 @@ from deeplearning4j_tpu.scaleout.remote_tracker import (
     StateTrackerServer,
     TrackerUnavailable,
 )
+from deeplearning4j_tpu.telemetry import trace as _trace
 
 log = logging.getLogger(__name__)
 
@@ -313,6 +327,9 @@ class ElasticWorker:
         self.tracker: Optional[StateTrackerClient] = None
         self.round = 0          # next round this worker will contribute to
         self.local_step = 0
+        # trace context of the master round span that published the last
+        # adopted global version — the parent for this worker's round spans
+        self._master_ctx: Optional[Dict] = None
 
     # -- tracker plumbing --
     def _connect(self) -> StateTrackerClient:
@@ -380,32 +397,48 @@ class ElasticWorker:
         stop = threading.Event()
         hb = threading.Thread(target=self._heartbeat_loop, args=(stop,),
                               daemon=True)
+        tracer = _trace.get_tracer()
         try:
             # join at the CURRENT version: pull averaged params + step and
             # get admitted from this round — the rejoin path and the cold
             # start are the same code
-            v = self._committed_version()
-            adopted = None
-            deadline = time.monotonic() + self.round_timeout_s
-            while adopted is None:
-                adopted = self._adopt(v, template)
-                if adopted is None:
-                    if time.monotonic() > deadline:
-                        raise ElasticTrainingError(
-                            f"worker {self.worker_id}: no global params "
-                            f"blob for version {v}")
-                    time.sleep(self.poll_s)
-            params, meta = adopted
-            self.round = v
-            self.local_step = int(meta.get("step", v * self.sync_every))
-            if v > 0:
-                self.tracker.increment("elastic.joined")
-            self.tracker.increment(f"admit.{self.worker_id}", float(v))
-            self._register()
+            with _trace.maybe_span("worker.join",
+                                   attrs={"worker": self.worker_id}):
+                v = self._committed_version()
+                adopted = None
+                deadline = time.monotonic() + self.round_timeout_s
+                while adopted is None:
+                    adopted = self._adopt(v, template)
+                    if adopted is None:
+                        if time.monotonic() > deadline:
+                            raise ElasticTrainingError(
+                                f"worker {self.worker_id}: no global params "
+                                f"blob for version {v}")
+                        time.sleep(self.poll_s)
+                params, meta = adopted
+                self._master_ctx = meta.get("trace")
+                self.round = v
+                self.local_step = int(meta.get("step", v * self.sync_every))
+                if v > 0:
+                    self.tracker.increment("elastic.joined")
+                self.tracker.increment(f"admit.{self.worker_id}", float(v))
+                self._register()
             hb.start()
+            if tracer is not None:
+                # write-ahead dump: a round-0 kill -9 still leaves this
+                tracer.flight_checkpoint(extra={"event": "registered",
+                                                "worker": self.worker_id,
+                                                "round": self.round})
             params = self._run_rounds(params, template)
             return {"worker_id": self.worker_id, "round": self.round,
                     "step": self.local_step}
+        except BaseException as exc:
+            if tracer is not None:
+                tracer.dump(type(exc).__name__, error=exc,
+                            extra={"worker": self.worker_id,
+                                   "round": self.round,
+                                   "step": self.local_step})
+            raise
         finally:
             stop.set()
             if self.tracker is not None:
@@ -414,6 +447,9 @@ class ElasticWorker:
     def _run_rounds(self, params, template):
         last_ok = time.monotonic()
         while True:
+            # re-read per round: tracing can be enabled/disabled mid-run
+            # (late configure, or the bench's round-alternating A/B)
+            tracer = _trace.get_tracer()
             try:
                 if self.tracker.is_done():
                     return params
@@ -424,29 +460,67 @@ class ElasticWorker:
                     adopted = self._adopt(v, template)
                     if adopted is not None:
                         params, meta = adopted
+                        self._master_ctx = meta.get("trace")
                         self.round = v
                         self.local_step = int(
                             meta.get("step", v * self.sync_every))
                 rnd = self.round
-                if self.crash_at_round is not None and \
-                        rnd >= self.crash_at_round:
-                    import os as _os
+                # the round span parents under the master round span that
+                # published the adopted version (its ctx rode the blob
+                # meta) — the cross-process link in the merged trace. A
+                # crash inside the ``with`` skips __exit__, leaving the
+                # begin-record on disk as an OPEN span for trace_report.
+                round_cm = (tracer.span(
+                                "worker.round",
+                                parent=self._master_ctx or False,
+                                attrs={"round": rnd,
+                                       "worker": self.worker_id,
+                                       "start_step": self.local_step})
+                            if tracer is not None
+                            else contextlib.nullcontext())
+                with round_cm:
+                    if self.crash_at_round is not None and \
+                            rnd >= self.crash_at_round:
+                        import os as _os
 
-                    params, _ = self.model.run_steps(
-                        params, self.local_step, self.crash_after_steps,
-                        self.worker_seed)
-                    _os._exit(23)  # kill -9 analogue: mid-round, unsynced
-                params, loss = self.model.run_steps(
-                    params, self.local_step, self.sync_every,
-                    self.worker_seed)
-                self.local_step += self.sync_every
-                self._publish(rnd, params, loss)
-                self.round = rnd + 1
-                # DeepSpark staleness window: block only once our lead over
-                # the committed version exceeds max_staleness
-                got = self._wait_version_at_least(
-                    self.round - self.max_staleness,
-                    time.monotonic() + self.round_timeout_s)
+                        with _trace.maybe_span(
+                                "worker.steps",
+                                attrs={"round": rnd,
+                                       "n_steps": self.crash_after_steps}):
+                            params, _ = self.model.run_steps(
+                                params, self.local_step,
+                                self.crash_after_steps, self.worker_seed)
+                        _os._exit(23)  # kill -9 analogue: mid-round, unsynced
+                    with _trace.maybe_span(
+                            "worker.steps",
+                            attrs={"round": rnd,
+                                   "start_step": self.local_step,
+                                   "n_steps": self.sync_every}) as ssp:
+                        params, loss = self.model.run_steps(
+                            params, self.local_step, self.sync_every,
+                            self.worker_seed)
+                        if ssp is not None:
+                            ssp.set_attr("loss", float(loss))
+                    self.local_step += self.sync_every
+                    with _trace.maybe_span(
+                            "worker.publish",
+                            attrs={"round": rnd, "worker": self.worker_id}):
+                        self._publish(rnd, params, loss)
+                    self.round = rnd + 1
+                    # DeepSpark staleness window: block only once our lead
+                    # over the committed version exceeds max_staleness
+                    with _trace.maybe_span(
+                            "worker.sync_wait",
+                            attrs={"round": rnd,
+                                   "wait_for_version":
+                                       self.round - self.max_staleness}):
+                        got = self._wait_version_at_least(
+                            self.round - self.max_staleness,
+                            time.monotonic() + self.round_timeout_s)
+                if tracer is not None:
+                    tracer.flight_checkpoint(
+                        extra={"worker": self.worker_id, "round": self.round,
+                               "step": self.local_step})
                 if got < 0:
                     return params
                 last_ok = time.monotonic()
@@ -482,9 +556,17 @@ class ElasticMaster:
                  register_timeout_s: float = 60.0,
                  round_timeout_s: float = 120.0, tick_s: float = 0.01,
                  checkpointer=None, checkpoint_every: int = 0,
-                 registry=None):
+                 registry=None, trace_dir: Optional[str] = None):
         from deeplearning4j_tpu.telemetry.registry import default_registry
 
+        # tracing: adopt the process tracer if one is configured; a
+        # trace_dir here is the convenience path that configures one
+        # (process name "master") including crash hooks
+        self.tracer = _trace.get_tracer()
+        if trace_dir is not None and self.tracer is None:
+            self.tracer = _trace.configure("master", trace_dir)
+        self._run_span = None
+        self._round_span = None
         self.server = server or StateTrackerServer()
         self.tracker = self.server.tracker  # embedded: zero-IPC master side
         self.blob_uri = blob_uri
@@ -514,9 +596,24 @@ class ElasticMaster:
         return self.server.address
 
     def _publish_version(self, version: int, params) -> None:
-        self.blob.put(_global_key(version), tree_to_bytes(
-            params, {"version": version,
-                     "step": version * self.sync_every}))
+        meta = {"version": version, "step": version * self.sync_every}
+        if self.tracer is not None:
+            if self._run_span is None:
+                self._run_span = self.tracer.start_span(
+                    "elastic.train", parent=False,
+                    attrs={"sync_every": self.sync_every,
+                           "min_workers": self.min_workers})
+            # the span for round ``version`` opens when version ``version``
+            # is published (workers adopt it and train round ``version``
+            # against it) and closes when version+1 commits; its context
+            # rides the blob meta so worker round spans parent under it
+            if self._round_span is not None:
+                self._round_span.end()
+            self._round_span = self.tracer.start_span(
+                "elastic.round", parent=self._run_span,
+                attrs={"round": version})
+            meta["trace"] = self._round_span.context()
+        self.blob.put(_global_key(version), tree_to_bytes(params, meta))
         # the counter IS the committed-version number; a resume can jump it
         # by more than one
         behind = version - self.tracker.count(VERSION_KEY)
@@ -588,11 +685,16 @@ class ElasticMaster:
         if (self.checkpointer is None or self.checkpoint_every <= 0
                 or version % self.checkpoint_every):
             return
-        self.checkpointer.save(
-            version, {"params": self._params},
-            meta={"elastic_version": version,
-                  "elastic_step": version * self.sync_every,
-                  "sync_every": self.sync_every})
+        ck_cm = (self.tracer.span("elastic.checkpoint",
+                                  parent=self._round_span,
+                                  attrs={"version": version})
+                 if self.tracer is not None else contextlib.nullcontext())
+        with ck_cm:
+            self.checkpointer.save(
+                version, {"params": self._params},
+                meta={"elastic_version": version,
+                      "elastic_step": version * self.sync_every,
+                      "sync_every": self.sync_every})
 
     def train(self, rounds: int, finish: bool = True):
         """Commit ``rounds`` averaging rounds (versions ``start+1 ..
@@ -606,43 +708,89 @@ class ElasticMaster:
             target = self.version + int(rounds)
             while self.version < target:
                 rnd = self.version  # collecting round ``rnd`` contributions
-                deadline = time.monotonic() + self.round_timeout_s
-                while True:
-                    for wid in self._dead_workers():
-                        self._bury(wid)
-                    live = self._live_workers()
-                    self.registry.gauge("elastic_live_workers").set(
-                        float(len(live)))
-                    if len(live) < self.min_workers:
-                        raise ElasticTrainingError(
-                            f"survivor set {live} below min_workers="
-                            f"{self.min_workers} at round {rnd} — halting "
-                            "(raise min_workers tolerance or add workers)")
-                    contribs = self._contributions(rnd)
-                    required = [w for w in live
-                                if self._admit_round(w) <= rnd]
-                    if required and all(w in contribs for w in required):
-                        break
-                    if time.monotonic() > deadline:
-                        raise ElasticTrainingError(
-                            f"round {rnd} barrier timed out after "
-                            f"{self.round_timeout_s}s: live={live} "
-                            f"contributed={sorted(contribs)}")
-                    time.sleep(self.tick_s)
+                contribs = self._barrier(rnd)
                 wids = sorted(contribs)  # deterministic averaging order
+                avg_sp = (self.tracer.start_span(
+                              "elastic.average", parent=self._round_span,
+                              attrs={"round": rnd, "n_contrib": len(wids)})
+                          if self.tracer is not None else None)
                 self._params = average_trees(
                     [contribs[w][0] for w in wids],
                     [contribs[w][1] for w in wids])
+                if avg_sp is not None:
+                    avg_sp.end()
                 self.version += 1
                 self._publish_version(self.version, self._params)
                 self.registry.counter("elastic_rounds_total").inc()
                 self.tracker.increment("rounds_completed")
                 self._maybe_checkpoint(self.version)
+                if self.tracer is not None:
+                    # write-ahead dump at the commit boundary: a later
+                    # master kill leaves at least this round's forensics
+                    self.tracer.flight_checkpoint(
+                        extra={"version": self.version,
+                               "contributors": wids})
             ok = True
             return self._params
+        except ElasticTrainingError as exc:
+            if self.tracer is not None:
+                self.tracer.dump("ElasticTrainingError", error=exc,
+                                 extra={"version": self.version})
+            raise
         finally:
             if finish or not ok:  # a failed run always releases the
                 self.tracker.finish()  # workers' poll loops
+
+    def _barrier(self, rnd: int) -> Dict[str, tuple]:
+        """Collect round ``rnd`` until every live worker admitted
+        at-or-before it has contributed (burying heartbeat-stale workers
+        along the way). Traced as ``elastic.barrier`` with a
+        ``contribution``/``buried`` event per arrival/death — the raw
+        material for trace_report's who-did-the-round-wait-on table."""
+        deadline = time.monotonic() + self.round_timeout_s
+        barrier_sp = (self.tracer.start_span(
+                          "elastic.barrier", parent=self._round_span,
+                          attrs={"round": rnd})
+                      if self.tracer is not None else None)
+        seen: set = set()
+        try:
+            while True:
+                for wid in self._dead_workers():
+                    self._bury(wid)
+                    if barrier_sp is not None:
+                        barrier_sp.add_event("buried", worker=wid)
+                live = self._live_workers()
+                self.registry.gauge("elastic_live_workers").set(
+                    float(len(live)))
+                if len(live) < self.min_workers:
+                    raise ElasticTrainingError(
+                        f"survivor set {live} below min_workers="
+                        f"{self.min_workers} at round {rnd} — halting "
+                        "(raise min_workers tolerance or add workers)")
+                contribs = self._contributions(rnd)
+                if barrier_sp is not None:
+                    for w in sorted(contribs):
+                        if w not in seen:
+                            seen.add(w)
+                            barrier_sp.add_event("contribution", worker=w)
+                required = [w for w in live
+                            if self._admit_round(w) <= rnd]
+                if required and all(w in contribs for w in required):
+                    if barrier_sp is not None:
+                        barrier_sp.set_attr("contributors", sorted(contribs))
+                        barrier_sp.set_attr("required", sorted(required))
+                        barrier_sp.end()
+                    return contribs
+                if time.monotonic() > deadline:
+                    raise ElasticTrainingError(
+                        f"round {rnd} barrier timed out after "
+                        f"{self.round_timeout_s}s: live={live} "
+                        f"contributed={sorted(contribs)}")
+                time.sleep(self.tick_s)
+        except BaseException as exc:
+            if barrier_sp is not None:
+                barrier_sp.end(error=exc)
+            raise
 
     def resume(self) -> Optional[int]:
         """Adopt the latest committed checkpoint (params + version); call
@@ -666,6 +814,13 @@ class ElasticMaster:
         if self.checkpointer is not None and hasattr(self.checkpointer,
                                                      "flush"):
             self.checkpointer.flush()
+        if self.tracer is not None:
+            if self._round_span is not None:
+                self._round_span.end()
+                self._round_span = None
+            if self._run_span is not None:
+                self._run_span.end()
+                self._run_span = None
         self.server.shutdown()
 
     def __enter__(self):
@@ -741,6 +896,9 @@ def worker_main(argv=None) -> None:
                    help="fault injection: os._exit mid-round at round N")
     p.add_argument("--crash-after-steps", type=int, default=1,
                    help="local steps to run inside the crashing round")
+    p.add_argument("--trace-dir", default=None,
+                   help="write per-process span JSONL + flight-recorder "
+                        "dumps under this directory (ISSUE 7)")
     args = p.parse_args(argv)
     model = _resolve_model(args.model, json.loads(args.kwargs_json))
     worker = ElasticWorker(
@@ -749,6 +907,8 @@ def worker_main(argv=None) -> None:
         worker_seed=args.worker_seed, round_timeout_s=args.round_timeout_s,
         crash_at_round=args.crash_at_round,
         crash_after_steps=args.crash_after_steps)
+    if args.trace_dir:
+        _trace.configure(worker.worker_id, args.trace_dir)
     summary = worker.run()
     print("ELASTIC_WORKER_DONE " + json.dumps(summary), flush=True)
 

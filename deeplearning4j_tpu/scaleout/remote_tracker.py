@@ -12,7 +12,14 @@ so every control-plane component (work routers, aggregators, early
 stopping, the runners) runs unchanged across process boundaries.
 
 Wire protocol: length-prefixed pickle frames carrying (method, args,
-kwargs) → (ok, result-or-exception). Pickle matches the payloads (Jobs
+kwargs[, trace_ctx]) → (ok, result-or-exception). The optional 4th
+element is a telemetry.trace span context ({"trace_id", "span_id"}) —
+present only when the calling thread is inside a traced operation — and
+the server, when a process tracer is configured, records a
+``tracker.serve`` span parented under it, so a worker's RPC and the
+master's handling of it land in ONE distributed trace (ISSUE 7). A
+3-tuple frame stays valid: tracing off ⇒ the PR 6 wire format, byte for
+byte. Pickle matches the payloads (Jobs
 holding numpy param arrays / DataSets) and the reference's posture
 (Hazelcast serialized arbitrary Java objects the same way); the listener
 binds to 127.0.0.1 by default and the boundary is trusted-cluster only —
@@ -55,6 +62,7 @@ from deeplearning4j_tpu.scaleout.statetracker import (
     InMemoryStateTracker,
     StateTracker,
 )
+from deeplearning4j_tpu.telemetry import trace as _trace
 
 _HDR = struct.Struct(">I")
 _MAX_FRAME = 1 << 30
@@ -72,6 +80,12 @@ class TrackerUnavailable(ConnectionError):
 # that are last-write-wins per key or compare-and-delete. ``increment`` and
 # the blind ``clear_updates`` are excluded — replaying either can
 # double-apply (double-count / drop an update that landed in between).
+# High-frequency poll methods whose per-call spans would be pure noise
+# (a version-wait loop issues dozens per round at poll_s cadence). Their
+# aggregate cost is exactly the enclosing span's duration (worker.sync_wait
+# etc), so skipping the per-poll spans loses nothing the timeline needs.
+_UNTRACED_POLLS = frozenset({"count", "is_done"})
+
 _IDEMPOTENT = frozenset({
     "add_worker", "remove_worker", "workers",
     "add_job", "job_for", "clear_job", "has_pending_jobs",
@@ -155,12 +169,23 @@ class StateTrackerServer:
             def handle(self):
                 try:
                     while True:
-                        method, args, kwargs = _recv_frame(self.request)
+                        frame = _recv_frame(self.request)
+                        method, args, kwargs = frame[:3]
+                        ctx = frame[3] if len(frame) > 3 else None
+                        tracer = _trace.get_tracer()
+                        sp = (tracer.start_span(
+                                  "tracker.serve", parent=ctx,
+                                  attrs={"method": method})
+                              if tracer is not None and ctx else None)
                         try:
                             fn = getattr(outer.tracker, method)
                             _send_frame(self.request,
                                         (True, fn(*args, **kwargs)))
+                            if sp is not None:
+                                sp.end()
                         except Exception as e:  # surfaced client-side
+                            if sp is not None:
+                                sp.end(error=e)
                             _send_frame(self.request, (False, e))
                 except (ConnectionError, EOFError, OSError):
                     return  # client went away; its state stays in the grid
@@ -240,29 +265,53 @@ class StateTrackerClient(StateTracker):
                 pass
             self._sock = None
 
-    def _roundtrip(self, method: str, args, kwargs):
+    def _roundtrip(self, method: str, args, kwargs, span=None):
         if self._sock is None:
             self._connect()
             self._registry.counter("tracker_reconnects_total").inc()
-        _send_frame(self._sock, (method, args, kwargs))
+            if span is not None:
+                span.add_event("reconnect")
+        if span is not None:
+            frame = (method, args, kwargs, span.context())
+        else:
+            frame = (method, args, kwargs)
+        _send_frame(self._sock, frame)
         return _recv_frame(self._sock)
 
     def _call(self, method: str, *args, **kwargs):
-        """One RPC with the retry policy. Any transport-layer failure —
-        timeout, reset, short/garbled frame — closes the socket; idempotent
-        methods then retry on a fresh connection, everything else surfaces
-        ``TrackerUnavailable`` immediately (see ``_IDEMPOTENT``)."""
+        """One RPC with the retry policy (see ``_call_locked``). When the
+        calling thread is inside a traced span, the RPC gets its own
+        ``tracker.rpc`` span (retries/reconnects as span events) and the
+        span context rides the frame to the server — a thread with no open
+        span (heartbeat loops, bare polls) stays on the untraced 3-tuple
+        path, so tracing never floods the sink with liveness chatter."""
+        tracer = _trace.get_tracer()
+        if (tracer is not None and method not in _UNTRACED_POLLS
+                and tracer.current_span() is not None):
+            with tracer.span("tracker.rpc",
+                             attrs={"method": method}) as sp:
+                return self._call_locked(method, args, kwargs, sp)
+        return self._call_locked(method, args, kwargs, None)
+
+    def _call_locked(self, method: str, args, kwargs, span):
+        """Any transport-layer failure — timeout, reset, short/garbled
+        frame — closes the socket; idempotent methods then retry on a
+        fresh connection, everything else surfaces ``TrackerUnavailable``
+        immediately (see ``_IDEMPOTENT``)."""
         attempts = (self._retries + 1) if method in _IDEMPOTENT else 1
         last_exc: Optional[BaseException] = None
         with self._lock:
             for attempt in range(attempts):
                 if attempt:
                     self._registry.counter("tracker_retries_total").inc()
+                    if span is not None:
+                        span.add_event("retry", attempt=attempt,
+                                       error=repr(last_exc))
                     delay = min(self._max_backoff_s,
                                 self._backoff_s * (2 ** (attempt - 1)))
                     time.sleep(delay * (0.5 + random.random() / 2))
                 try:
-                    ok, result = self._roundtrip(method, args, kwargs)
+                    ok, result = self._roundtrip(method, args, kwargs, span)
                 except (ConnectionError, socket.timeout, OSError, EOFError,
                         struct.error, pickle.UnpicklingError) as exc:
                     last_exc = exc

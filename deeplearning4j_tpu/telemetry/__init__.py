@@ -10,7 +10,12 @@ Three layers, one subsystem:
   which buffers device metrics and syncs once per N steps;
 - **export** (prometheus.py + ui/server.py routes): Prometheus text format
   at ``/metrics``, JSON snapshot at ``/api/telemetry``, device memory at
-  ``/api/memory``.
+  ``/api/memory``, live trace spans at ``/api/trace``;
+- **tracing** (trace.py, ISSUE 7): span-based distributed tracing across
+  the elastic control plane (context propagation over the tracker frame
+  protocol and blob metas) + a per-process crash flight recorder dumped
+  on error/SIGTERM and checkpointed write-ahead at round boundaries —
+  merged into round timelines by tools/trace_report.py.
 
 The listener chain bridges in via optimize/listeners.MetricsIterationListener
 and the scaleout counters via the statetracker registry mirror.
@@ -38,6 +43,14 @@ from deeplearning4j_tpu.telemetry.session import (
     DEFAULT_INTERVAL,
     TrainTelemetry,
 )
+from deeplearning4j_tpu.telemetry.trace import (
+    Span,
+    Tracer,
+    current_trace_context,
+    get_tracer,
+    maybe_span,
+    set_tracer,
+)
 from deeplearning4j_tpu.telemetry.step_log import (
     StepLogWriter,
     read_step_log,
@@ -52,9 +65,15 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "PROMETHEUS_CONTENT_TYPE",
+    "Span",
     "StepLogWriter",
+    "Tracer",
     "TrainTelemetry",
+    "current_trace_context",
     "default_registry",
+    "get_tracer",
+    "maybe_span",
+    "set_tracer",
     "global_norm",
     "read_step_log",
     "render_prometheus",

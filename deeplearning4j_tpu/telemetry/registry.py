@@ -17,6 +17,27 @@ Instruments are identified by (name, labels); ``counter/gauge/histogram``
 are get-or-create so call sites never need registration ceremony. All
 operations are thread-safe — scaleout workers on many threads report into
 one registry (the StateTracker mirror in scaleout/statetracker.py).
+
+Concurrency model (audited for ISSUE 7 — the AsyncCheckpointer writer
+thread, tracker server handler threads, UI request threads, and the
+tracer all hit one registry concurrently with training-loop writers):
+
+- every instrument guards its state with its own ``threading.Lock``;
+  ``inc``/``set``/``observe`` and the read properties are atomic, so N
+  threads × M increments always total exactly N·M (pinned in
+  tests/test_telemetry.py::TestRegistryConcurrency);
+- the registry's get-or-create maps are guarded by one ``RLock``;
+  instrument methods never take the registry lock, so there is no
+  lock-ordering cycle (``snapshot`` takes registry → instrument, never
+  the reverse);
+- ``snapshot()`` is per-instrument-consistent, not globally atomic: a
+  scrape racing writers sees each instrument's value at *some* point
+  during the scrape — fine for monitoring, not a barrier;
+- cross-PROCESS isolation is deliberate: elastic worker OS processes
+  each have their own ``default_registry()`` (fork/spawn copies share
+  nothing after start). Cross-process aggregation goes through the
+  tracker's counters (``counters_snapshot``) and the per-process flight
+  recorder dumps, never through shared registry memory.
 """
 
 from __future__ import annotations

@@ -68,13 +68,26 @@ class StepLogWriter:
 
 
 def read_step_log(path: str) -> List[Dict]:
-    """Parse a JSONL step log back into records (skips blank lines)."""
+    """Parse a JSONL step log back into records (skips blank lines).
+
+    A malformed line raises ``ValueError`` naming the file and line
+    number — a truncated log (writer killed mid-line) or a corrupted one
+    is a clear diagnostic for callers (tools/telemetry_report.py turns it
+    into a message + nonzero exit), never a bare JSONDecodeError
+    traceback pointing at nothing.
+    """
     out = []
     with open(path) as fh:
-        for line in fh:
+        for lineno, line in enumerate(fh, start=1):
             line = line.strip()
-            if line:
+            if not line:
+                continue
+            try:
                 out.append(json.loads(line))
+            except json.JSONDecodeError as exc:
+                raise ValueError(
+                    f"step log {path} is truncated or corrupt at line "
+                    f"{lineno}: {exc}") from exc
     return out
 
 

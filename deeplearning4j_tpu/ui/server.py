@@ -29,6 +29,7 @@ INDEX_HTML = """<!doctype html>
 <li><a href="/metrics">Prometheus metrics</a></li>
 <li><a href="/api/telemetry">telemetry snapshot (JSON)</a></li>
 <li><a href="/api/memory">device memory stats</a></li>
+<li><a href="/api/trace">live trace spans (open + recent)</a></li>
 </ul>
 <h2>api</h2>
 <ul>
@@ -64,6 +65,7 @@ class UiServer:
         self._thread: Optional[threading.Thread] = None
         self.port: Optional[int] = None
         self._metrics_registry = None
+        self._tracer = None
 
     # ---- telemetry (ISSUE 2: Prometheus + JSON export on the UI port) ----
     def attach_metrics(self, registry) -> None:
@@ -72,6 +74,15 @@ class UiServer:
         the registry is read at request time, so a training loop writing
         into it is immediately visible to scrapers."""
         self._metrics_registry = registry
+
+    # ---- tracing (ISSUE 7: live span view on the UI port) ----
+    def attach_tracer(self, tracer) -> None:
+        """Serve a telemetry.trace.Tracer's flight-recorder ring at
+        ``/api/trace`` (open spans with elapsed-so-far durations + the
+        last-N ended spans). Read at request time — a scrape during a
+        round shows the round/barrier spans still open. Falls back to the
+        process tracer when none is attached explicitly."""
+        self._tracer = tracer
 
     # ---- uploads (ref ApiResource: the reference POSTs these; in-process
     # registration serves the same purpose without copying through HTTP) ----
@@ -163,6 +174,20 @@ class UiServer:
                     )
 
                     self._json({"devices": device_memory_stats()})
+                elif url.path == "/api/trace":
+                    from deeplearning4j_tpu.telemetry import trace as _trace
+
+                    tracer = ui._tracer or _trace.get_tracer()
+                    if tracer is None:
+                        self._json({"error": "no tracer attached"}, 404)
+                        return
+                    try:
+                        limit = int(q.get("limit", ["64"])[0])
+                    except ValueError:
+                        self._json({"error": "limit must be an integer"},
+                                   400)
+                        return
+                    self._json(tracer.snapshot(limit=limit))
                 elif url.path == "/api/words":
                     self._json({"count": len(ui._words), "words": ui._words[:200]})
                 elif url.path == "/api/nearest":

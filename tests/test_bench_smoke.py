@@ -212,3 +212,45 @@ def test_bench_fault_tolerance_stages_on_cpu():
         assert cfg["steps_per_sec"] > 0
     # infrequent sync is faster wall-clock (fewer averaging barriers)
     assert per["32"]["steps_per_sec"] >= per["1"]["steps_per_sec"], per
+
+
+def test_bench_elastic_trace_stage_on_cpu():
+    """ISSUE 7 acceptance: the traced elastic round stays under the <5%
+    overhead budget vs untraced (round-alternating paired estimator, same
+    discipline as the PR 2 metrics budget), and the stage's forensic
+    chain lands: spans on disk, a trace_report timeline with every round
+    committed, a Chrome export, and a flight dump.
+
+    The estimator's documented noise floor on a shared-CPU box is ~±1.5%
+    (trimmed mean of 20 paired deltas; see measure_elastic_trace), so a
+    single reading can graze the budget on a bad scheduler day — one
+    retry keeps the gate honest (a REAL regression, like per-poll spans
+    or uncapped dumps, measures 10-20% and fails both runs)."""
+
+    def run_stage():
+        env = dict(os.environ)
+        env["BENCH_FORCE_CPU"] = "1"
+        env["BENCH_FAST"] = "1"
+        env["BENCH_BUDGET_SEC"] = "200"
+        env["BENCH_ONLY"] = "elastic_trace"
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True, text=True, timeout=260, cwd=REPO, env=env,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        det = json.loads(out.stdout.strip().splitlines()[-1])["detail"]
+        assert det.get("elastic_trace_overhead_pct") is not None, det.get(
+            "elastic_trace_status")
+        return det
+
+    det = run_stage()
+    sd = det["elastic_trace_detail"]
+    # forensic chain (stable, no retry needed)
+    assert sd["spans"] > 10
+    assert sd["rounds_committed_in_report"] == 4
+    assert sd["chrome_events"] > sd["spans"]  # spans + process metadata
+    assert sd["flight_dump"] is True
+    assert sd["plain_round_ms"] > 0 and sd["traced_round_ms"] > 0
+    if sd["overhead_pct"] >= 5.0:  # noise-floor retry, see docstring
+        sd = run_stage()["elastic_trace_detail"]
+    assert sd["overhead_pct"] < 5.0, sd

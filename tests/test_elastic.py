@@ -37,10 +37,15 @@ from deeplearning4j_tpu.scaleout.remote_tracker import (
     StateTrackerServer,
     TrackerUnavailable,
 )
+from deeplearning4j_tpu.telemetry import trace as trace_mod
 from deeplearning4j_tpu.telemetry.registry import MetricsRegistry
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TESTS = os.path.join(REPO, "tests")
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)  # tools.trace_report import below
+
+from tools.trace_report import build_timeline, load_trace_dir  # noqa: E402
 
 SYNC = 3
 
@@ -102,22 +107,36 @@ def test_elastic_kill_recover_smoke(tmp_path):
     hard-exits mid-round (before publishing — its delta is unsynced), the
     master deregisters it on heartbeat staleness and commits every round
     on the survivor set. Final averaged params match the survivor-set
-    oracle to 1e-6 and ``workers_failed`` is incremented."""
+    oracle to 1e-6 and ``workers_failed`` is incremented.
+
+    ISSUE 7 rides the same run: every process traces into a shared dir,
+    and the kill -9 must leave forensics, not silence — the victim's
+    flight-recorder dump (written ahead at registration), its UNCLOSED
+    round-0 spans on disk, and a trace_report timeline that merges all
+    three processes with barrier-wait attribution."""
     blob = f"file://{tmp_path / 'blob'}"
-    master = ElasticMaster(_model(), blob, sync_every=SYNC, min_workers=1,
-                           worker_timeout_s=2.0, register_timeout_s=120,
-                           round_timeout_s=120)
-    procs = [
-        _spawn_worker(master.address, blob, "survivor", seed=1),
-        _spawn_worker(master.address, blob, "victim", seed=2,
-                      extra=["--crash-at-round", "0",
-                             "--crash-after-steps", "1"]),
-    ]
+    trace_dir = str(tmp_path / "trace")
+    prev = trace_mod.set_tracer(trace_mod.Tracer(
+        "master", trace_dir=trace_dir, registry=MetricsRegistry()))
     try:
-        master.wait_for_workers(2)  # both registered before the kill lands
-        final = master.train(rounds=3)
+        master = ElasticMaster(_model(), blob, sync_every=SYNC,
+                               min_workers=1, worker_timeout_s=2.0,
+                               register_timeout_s=120, round_timeout_s=120)
+        procs = [
+            _spawn_worker(master.address, blob, "survivor", seed=1,
+                          extra=["--trace-dir", trace_dir]),
+            _spawn_worker(master.address, blob, "victim", seed=2,
+                          extra=["--crash-at-round", "0",
+                                 "--crash-after-steps", "1",
+                                 "--trace-dir", trace_dir]),
+        ]
+        try:
+            master.wait_for_workers(2)  # both registered before the kill
+            final = master.train(rounds=3)
+        finally:
+            outs = _finish(procs, master)
     finally:
-        outs = _finish(procs, master)
+        trace_mod.set_tracer(prev)
     assert procs[1].returncode == 23, outs[1][1][-500:]  # the os._exit mark
     assert master.tracker.count("workers_failed") == 1
     assert "victim" not in master.tracker.workers()
@@ -126,6 +145,51 @@ def test_elastic_kill_recover_smoke(tmp_path):
     _assert_tree_close(final, ref, 1e-6, "survivor-set parity")
     # the survivor exited cleanly on the done flag, not by being killed
     assert procs[0].returncode == 0, outs[0][1][-500:]
+
+    # ---- forensics (ISSUE 7 acceptance) ----
+    for proc_name in ("master", "survivor", "victim"):
+        assert os.path.exists(
+            os.path.join(trace_dir, f"spans_{proc_name}.jsonl")), proc_name
+    # the kill -9 victim cannot run hooks; its write-ahead dump (from
+    # registration) must exist anyway
+    victim_dump = os.path.join(trace_dir, "flightrec_victim.json")
+    assert os.path.exists(victim_dump)
+    assert json.load(open(victim_dump))["reason"] == "checkpoint"
+    spans = load_trace_dir(trace_dir)
+    victim_open = [sp for sp in spans.values()
+                   if sp.get("process") == "victim"
+                   and sp.get("status") == "open"]
+    assert any(sp["name"] == "worker.round" for sp in victim_open), (
+        "victim died mid-round: its round span must be reconstructed as "
+        f"open, got {[s['name'] for s in victim_open]}")
+    timeline = build_timeline(spans)
+    committed = [r for r in timeline["rounds"]
+                 if r["status"] == "committed"]
+    assert [r["round"] for r in committed] == [0, 1, 2]
+    r0 = committed[0]
+    # round 0's merged view: the survivor contributed, the victim's
+    # unclosed spans are attributed to the round it died in
+    assert [a["worker"] for a in r0["contributors"]] == ["survivor"]
+    assert "victim:worker.round" in r0["open_spans"]
+    assert r0["straggler"] == "survivor"
+    # cross-process link: a survivor round span parents under a master
+    # round span (the ctx rode the published blob meta)
+    master_rounds = {sp["span_id"] for sp in spans.values()
+                     if sp["name"] == "elastic.round"}
+    worker_rounds = [sp for sp in spans.values()
+                     if sp["name"] == "worker.round"
+                     and sp.get("process") == "survivor"]
+    assert worker_rounds and all(
+        sp.get("parent_id") in master_rounds for sp in worker_rounds)
+    # the CLI renders the same reconstruction
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_report.py"),
+         trace_dir, "--chrome", str(tmp_path / "chrome.json")],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert out.returncode == 0, out.stderr
+    assert "victim:worker.round" in out.stdout
+    chrome = json.load(open(tmp_path / "chrome.json"))
+    assert len(chrome["traceEvents"]) > 10
 
 
 @pytest.mark.slow
@@ -249,25 +313,112 @@ def test_elastic_staleness_runs_ahead_of_commits(tmp_path):
 
 def test_elastic_min_workers_halts_below_quorum(tmp_path):
     """Degrade-vs-halt: with ``min_workers=2`` the loss of one of two
-    workers is a loud ElasticTrainingError, not silent degraded training."""
+    workers is a loud ElasticTrainingError, not silent degraded training —
+    and (ISSUE 7) the master's flight recorder dumps on the error, with
+    the failed barrier span recording the burial."""
     from deeplearning4j_tpu.scaleout.elastic import ElasticTrainingError
 
     blob = f"file://{tmp_path / 'blob'}"
-    master = ElasticMaster(_model(), blob, sync_every=SYNC, min_workers=2,
-                           worker_timeout_s=1.5, register_timeout_s=120,
-                           round_timeout_s=60)
-    procs = [
-        _spawn_worker(master.address, blob, "w0", seed=1),
-        _spawn_worker(master.address, blob, "crash", seed=2,
-                      extra=["--crash-at-round", "0"]),
-    ]
+    trace_dir = str(tmp_path / "trace")
+    prev = trace_mod.set_tracer(trace_mod.Tracer(
+        "master", trace_dir=trace_dir, registry=MetricsRegistry()))
     try:
-        master.wait_for_workers(2)
-        with pytest.raises(ElasticTrainingError, match="min_workers"):
-            master.train(rounds=4)
+        master = ElasticMaster(_model(), blob, sync_every=SYNC,
+                               min_workers=2, worker_timeout_s=1.5,
+                               register_timeout_s=120, round_timeout_s=60)
+        procs = [
+            _spawn_worker(master.address, blob, "w0", seed=1),
+            _spawn_worker(master.address, blob, "crash", seed=2,
+                          extra=["--crash-at-round", "0"]),
+        ]
+        try:
+            master.wait_for_workers(2)
+            with pytest.raises(ElasticTrainingError, match="min_workers"):
+                master.train(rounds=4)
+        finally:
+            _finish(procs, master)
     finally:
-        _finish(procs, master)
+        trace_mod.set_tracer(prev)
     assert master.tracker.count("workers_failed") == 1
+    # the halt left a forensic artifact naming the error
+    dump_path = os.path.join(trace_dir, "flightrec_master.json")
+    assert os.path.exists(dump_path)
+    dump = json.load(open(dump_path))
+    assert dump["reason"] == "ElasticTrainingError"
+    assert "min_workers" in dump["error"]
+    # the barrier span carries the burial event and the error status
+    spans = load_trace_dir(trace_dir)
+    barriers = [sp for sp in spans.values()
+                if sp["name"] == "elastic.barrier"]
+    assert any(sp.get("status") == "error" for sp in barriers)
+    assert any(ev.get("name") == "buried" and ev.get("worker") == "crash"
+               for sp in barriers for ev in sp.get("events", []))
+
+
+def test_master_crash_mid_merge_leaves_flight_dump(tmp_path):
+    """ISSUE 7's master-crash-mid-merge forensics: a coordinator stuck in
+    the ``merge_save`` barrier (one of two part manifests missing) is
+    SIGTERMed — the crash hook dumps the flight recorder with the OPEN
+    ``ckpt.merge_save`` span, and trace_report reconstructs the partial
+    merge from the begin-record the kill left on disk. (The durability
+    half — no committed manifest, clean resume — is pinned in
+    test_ckpt_resume.)"""
+    import signal
+
+    root = str(tmp_path / "ckpt")
+    trace_dir = str(tmp_path / "trace")
+    child_code = (
+        "import sys\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import jax.numpy as jnp\n"
+        "from deeplearning4j_tpu.telemetry import trace as tr\n"
+        "from deeplearning4j_tpu.scaleout.ckpt import Checkpointer\n"
+        "root, trace_dir = sys.argv[1], sys.argv[2]\n"
+        "tr.configure('merge-master', trace_dir)\n"
+        "ck = Checkpointer(root)\n"
+        "ck.save_process(1, {'w': jnp.arange(8.0)}, process_index=0)\n"
+        "ck.merge_save(1, n_processes=2, timeout_s=120)\n"  # blocks
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = f"{REPO}{os.pathsep}" + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", child_code, root, trace_dir],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        # wait until the merge span's begin-record is on disk (the child
+        # is then parked in the part-manifest barrier), then SIGTERM it
+        span_file = os.path.join(trace_dir, "spans_merge-master.jsonl")
+        deadline = time.monotonic() + 60
+        while True:
+            if os.path.exists(span_file) and \
+                    "ckpt.merge_save" in open(span_file).read():
+                break
+            assert time.monotonic() < deadline, "merge span never started"
+            assert proc.poll() is None, proc.communicate()[1][-800:]
+            time.sleep(0.05)
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    dump_path = os.path.join(trace_dir, "flightrec_merge-master.json")
+    assert os.path.exists(dump_path)
+    dump = json.load(open(dump_path))
+    assert dump["reason"] == "SIGTERM"
+    assert any(sp["name"] == "ckpt.merge_save" and sp.get("open")
+               for sp in dump["open"])
+    # trace_report reconstructs the partial merge from the torn span file
+    spans = load_trace_dir(trace_dir)
+    merge = [sp for sp in spans.values()
+             if sp["name"] == "ckpt.merge_save"][0]
+    assert merge["status"] == "open"
+    assert merge["attrs"]["n_processes"] == 2
+    # the kill landed before the commit: no manifest, nothing to resume
+    from deeplearning4j_tpu.scaleout.ckpt import Checkpointer
+
+    assert Checkpointer(root).latest_step() is None
 
 
 # ------------------------------------------------------------ transport ----

@@ -683,3 +683,120 @@ class TestTelemetryReport:
              str(tmp_path / "nope.jsonl")],
             capture_output=True, text=True, timeout=60, cwd=REPO)
         assert out.returncode == 2
+
+    def test_report_truncated_log_clear_message(self, tmp_path):
+        """ISSUE 7 satellite: a step log whose writer was killed mid-line
+        (or whose disk filled) gets a clear message naming the bad line
+        and a nonzero exit — never a JSONDecodeError traceback."""
+        path = tmp_path / "steps.jsonl"
+        with StepLogWriter(str(path)) as w:
+            w.write(0, loss=2.0)
+            w.write(1, loss=1.5)
+        with open(path, "a") as fh:
+            fh.write('{"ts": 3.0, "step": 2, "lo')  # torn tail
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "telemetry_report.py"),
+             str(path)],
+            capture_output=True, text=True, timeout=60, cwd=REPO)
+        assert out.returncode == 3
+        assert "truncated or corrupt" in out.stderr
+        assert "line 3" in out.stderr
+        assert "Traceback" not in out.stderr
+
+    def test_report_empty_log_clear_message(self, tmp_path):
+        path = tmp_path / "steps.jsonl"
+        path.write_text("\n\n")
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "telemetry_report.py"),
+             str(path)],
+            capture_output=True, text=True, timeout=60, cwd=REPO)
+        assert out.returncode == 3
+        assert "empty" in out.stderr
+
+    def test_read_step_log_names_bad_line(self, tmp_path):
+        path = tmp_path / "steps.jsonl"
+        path.write_text('{"step": 0}\nnot json\n')
+        with pytest.raises(ValueError, match=r"line 2"):
+            read_step_log(str(path))
+
+
+# ------------------------------------------- registry thread-safety pin ----
+
+class TestRegistryConcurrency:
+    """ISSUE 7 satellite: the AsyncCheckpointer writer thread, tracker
+    server handler threads, UI scrapers, and the tracer all hit one
+    registry concurrently with training-loop writers. Per-instrument
+    locks must make increments exact and snapshots crash-free; the
+    cross-PROCESS story is isolation by design (see registry.py doc)."""
+
+    def test_concurrent_increments_are_exact(self):
+        import threading
+
+        reg = MetricsRegistry()
+        threads_n, per_thread = 8, 5000
+
+        def work(i):
+            c = reg.counter("hits", {"shared": "yes"})
+            g = reg.gauge("level")
+            h = reg.histogram("lat_ms")
+            for k in range(per_thread):
+                c.inc()
+                g.inc(1.0)
+                h.observe(float(k % 7))
+
+        threads = [__import__("threading").Thread(target=work, args=(i,))
+                   for i in range(threads_n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        total = threads_n * per_thread
+        assert reg.counter("hits", {"shared": "yes"}).value == total
+        assert reg.gauge("level").value == total
+        h = reg.histogram("lat_ms")
+        assert h.count == total
+        snap = h.snapshot()
+        assert snap["buckets"][-1]["count"] == total  # +Inf is cumulative
+
+    def test_snapshot_safe_under_concurrent_writes(self):
+        import threading
+
+        reg = MetricsRegistry()
+        stop = threading.Event()
+        errors = []
+
+        def writer(i):
+            c = reg.counter(f"w{i}")
+            while not stop.is_set():
+                c.inc()
+                reg.histogram("obs").observe(1.0)
+
+        def reader():
+            try:
+                while not stop.is_set():
+                    snap = reg.snapshot()
+                    for c in snap["counters"]:
+                        assert c["value"] >= 0
+                    render_prometheus(reg)  # the /metrics path too
+            except Exception as exc:  # surfaced after join
+                errors.append(exc)
+
+        threads = ([threading.Thread(target=writer, args=(i,))
+                    for i in range(4)]
+                   + [threading.Thread(target=reader) for _ in range(2)])
+        for t in threads:
+            t.start()
+        import time as _time
+
+        _time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errors, errors
+        # get-or-create under the registry lock: exactly one instrument
+        # per (name, labels) key survived the race
+        snap = reg.snapshot()
+        names = [c["name"] for c in snap["counters"]]
+        assert len(names) == len(set(names))

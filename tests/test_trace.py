@@ -1,0 +1,403 @@
+"""ISSUE 7: span-based distributed tracing + crash flight recorder.
+
+Unit-level pins for telemetry/trace.py (span model, JSONL begin/end
+records, context propagation, flight-recorder dumps and their rate
+limit), the tracker-frame propagation in remote_tracker.py, and the
+tools/trace_report.py reconstruction — including the partial-round case
+a kill -9 leaves behind (begin records with no end).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from deeplearning4j_tpu.telemetry import trace as tr
+from deeplearning4j_tpu.telemetry.registry import MetricsRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.trace_report import (  # noqa: E402
+    build_timeline,
+    chrome_trace,
+    load_trace_dir,
+)
+
+
+@pytest.fixture
+def no_global_tracer():
+    """Isolate the process-global tracer; restore whatever was there."""
+    prev = tr.set_tracer(None)
+    yield
+    tr.set_tracer(prev)
+
+
+def _read_records(path):
+    with open(path) as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+class TestSpans:
+    def test_nesting_parents_and_jsonl_records(self, tmp_path,
+                                               no_global_tracer):
+        t = tr.Tracer("p0", trace_dir=str(tmp_path),
+                      registry=MetricsRegistry())
+        with t.span("outer", attrs={"k": 1}) as outer:
+            assert t.current_span() is outer
+            with t.span("inner") as inner:
+                assert inner.parent_id == outer.span_id
+                assert inner.trace_id == outer.trace_id
+            assert t.current_span() is outer
+        assert t.current_span() is None
+        recs = _read_records(tmp_path / "spans_p0.jsonl")
+        # begin records written eagerly (crash durability), ends after
+        assert [r["ev"] for r in recs] == ["B", "B", "E", "E"]
+        assert recs[0]["name"] == "outer" and recs[1]["name"] == "inner"
+        assert recs[2]["name"] == "inner" and recs[2]["status"] == "ok"
+        assert recs[2]["dur_ms"] >= 0
+
+    def test_error_status_and_events(self, tmp_path, no_global_tracer):
+        t = tr.Tracer("p0", trace_dir=str(tmp_path),
+                      registry=MetricsRegistry())
+        with pytest.raises(ValueError):
+            with t.span("boom") as sp:
+                sp.add_event("about_to_fail", detail="x")
+                raise ValueError("synthetic")
+        end = [r for r in _read_records(tmp_path / "spans_p0.jsonl")
+               if r["ev"] == "E"][0]
+        assert end["status"] == "error"
+        assert "synthetic" in end["error"]
+        assert end["events"][0]["name"] == "about_to_fail"
+        assert t.registry.counter("trace_spans_error_total").value == 1
+
+    def test_wire_context_parents_across_tracers(self, tmp_path,
+                                                 no_global_tracer):
+        """Two tracers = two processes: a context dict shipped over any
+        transport parents the remote span under the local one."""
+        master = tr.Tracer("master", trace_dir=str(tmp_path),
+                           registry=MetricsRegistry())
+        worker = tr.Tracer("worker", trace_dir=str(tmp_path),
+                           registry=MetricsRegistry())
+        root = master.start_span("round", attrs={"round": 0})
+        ctx = root.context()  # JSON-safe wire dict
+        ctx = json.loads(json.dumps(ctx))
+        child = worker.start_span("work", parent=ctx)
+        child.end()
+        root.end()
+        assert child.trace_id == root.trace_id
+        assert child.parent_id == root.span_id
+
+    def test_thread_local_current_span(self, tmp_path, no_global_tracer):
+        t = tr.Tracer("p0", trace_dir=str(tmp_path),
+                      registry=MetricsRegistry())
+        seen = {}
+
+        def other_thread():
+            seen["current"] = t.current_span()
+
+        with t.span("main-thread"):
+            th = threading.Thread(target=other_thread)
+            th.start()
+            th.join()
+        # another thread never silently parents under this thread's span
+        assert seen["current"] is None
+
+    def test_maybe_span_is_noop_without_tracer(self, no_global_tracer):
+        assert tr.get_tracer() is None
+        with tr.maybe_span("anything", attrs={"x": 1}) as sp:
+            assert sp is None
+        assert tr.current_trace_context() is None
+
+
+class TestFlightRecorder:
+    def test_dump_contents(self, tmp_path, no_global_tracer):
+        reg = MetricsRegistry()
+        reg.counter("workers_failed").inc(2)
+        t = tr.Tracer("w0", trace_dir=str(tmp_path), registry=reg)
+        with t.span("done-span"):
+            pass
+        open_span = t.start_span("stuck-span", attrs={"round": 3})
+        path = t.dump("SIGTERM", error=RuntimeError("killed"),
+                      extra={"note": "test"})
+        assert path == str(tmp_path / "flightrec_w0.json")
+        dump = json.load(open(path))
+        assert dump["reason"] == "SIGTERM"
+        assert "killed" in dump["error"]
+        assert dump["extra"]["note"] == "test"
+        assert [s["name"] for s in dump["open"]] == ["stuck-span"]
+        assert dump["open"][0]["open"] is True
+        assert dump["open"][0]["dur_ms"] >= 0
+        assert any(r["name"] == "done-span" for r in dump["recent"])
+        counters = {c["name"]: c["value"]
+                    for c in dump["counters"]["counters"]}
+        assert counters["workers_failed"] == 2
+        assert "device_memory" in dump
+        open_span.end()
+
+    def test_checkpoint_rate_limit(self, tmp_path, no_global_tracer):
+        t = tr.Tracer("w0", trace_dir=str(tmp_path),
+                      registry=MetricsRegistry(),
+                      min_checkpoint_interval_s=60.0)
+        assert t.flight_checkpoint() is not None  # first always lands
+        assert t.flight_checkpoint() is None      # inside the interval
+        assert t.dump("crash") is not None        # explicit never limited
+
+    def test_dump_never_raises(self, tmp_path, no_global_tracer):
+        t = tr.Tracer("w0", trace_dir=str(tmp_path),
+                      flight_path="/nonexistent-dir/cannot/write.json",
+                      registry=MetricsRegistry())
+        assert t.dump("crash") is None  # swallowed, not raised
+
+
+class TestTrackerPropagation:
+    def test_rpc_span_links_client_and_server(self, tmp_path,
+                                              no_global_tracer):
+        from deeplearning4j_tpu.scaleout.remote_tracker import (
+            StateTrackerClient,
+            StateTrackerServer,
+        )
+
+        tracer = tr.Tracer("node", trace_dir=str(tmp_path),
+                           registry=MetricsRegistry())
+        tr.set_tracer(tracer)
+        with StateTrackerServer() as server:
+            client = StateTrackerClient(server.address,
+                                        registry=MetricsRegistry())
+            # outside any span: the 3-tuple untraced wire path
+            client.add_worker("w-untraced")
+            with tracer.span("op") as op:
+                client.add_worker("w-traced")
+                client.count("poll.key")  # poll method: never spanned
+            client.close()
+            time.sleep(0.1)  # server handler writes its span async
+        spans = load_trace_dir(str(tmp_path))
+        by_name = {}
+        for sp in spans.values():
+            by_name.setdefault(sp["name"], []).append(sp)
+        assert len(by_name["tracker.rpc"]) == 1  # only the traced call
+        rpc = by_name["tracker.rpc"][0]
+        assert rpc["attrs"]["method"] == "add_worker"
+        assert rpc["parent_id"] == op.span_id
+        serve = by_name["tracker.serve"][0]
+        assert serve["parent_id"] == rpc["span_id"]
+        assert serve["trace_id"] == rpc["trace_id"] == op.trace_id
+
+    def test_retry_recorded_as_event(self, tmp_path, no_global_tracer):
+        import _dist_helpers
+        from deeplearning4j_tpu.scaleout.remote_tracker import (
+            StateTrackerClient,
+            StateTrackerServer,
+        )
+
+        tracer = tr.Tracer("node", trace_dir=str(tmp_path),
+                           registry=MetricsRegistry())
+        tr.set_tracer(tracer)
+        with StateTrackerServer() as server:
+            with _dist_helpers.FaultyTrackerProxy(
+                    server.address, cut_response_after=0) as proxy:
+                client = StateTrackerClient(proxy.address,
+                                            request_timeout_s=5, retries=3,
+                                            backoff_s=0.01,
+                                            registry=MetricsRegistry())
+                with tracer.span("op"):
+                    assert client.workers() == []  # cut → reconnect+retry
+                client.close()
+        spans = load_trace_dir(str(tmp_path))
+        rpc = [s for s in spans.values() if s["name"] == "tracker.rpc"][0]
+        names = [e["name"] for e in rpc["events"]]
+        assert "retry" in names and "reconnect" in names
+
+
+class TestTraceReport:
+    def _fake_elastic_trace(self, d, kill_worker_mid_round=None):
+        """Synthesize a master + two-worker trace the way elastic.py
+        writes it; optionally leave w1's round-N spans unclosed (the
+        kill -9 shape)."""
+        reg = MetricsRegistry()
+        master = tr.Tracer("master", trace_dir=str(d), registry=reg)
+        workers = {w: tr.Tracer(w, trace_dir=str(d),
+                                registry=MetricsRegistry())
+                   for w in ("w0", "w1")}
+        run = master.start_span("elastic.train", parent=False)
+        for rnd in range(3):
+            round_sp = master.start_span("elastic.round", parent=run,
+                                         attrs={"round": rnd})
+            barrier = master.start_span("elastic.barrier", parent=round_sp,
+                                        attrs={"round": rnd})
+            for i, (w, wt) in enumerate(sorted(workers.items())):
+                killed = (kill_worker_mid_round is not None
+                          and w == "w1" and rnd == kill_worker_mid_round)
+                wr = wt.start_span("worker.round",
+                                   parent=round_sp.context(),
+                                   attrs={"round": rnd, "worker": w})
+                steps = wt.start_span("worker.steps", parent=wr,
+                                      attrs={"round": rnd})
+                steps.end()
+                if killed:
+                    continue  # kill -9: round/publish spans never close
+                pub = wt.start_span("worker.publish", parent=wr,
+                                    attrs={"round": rnd, "worker": w})
+                time.sleep(0.002 * (i + 1))  # staggered arrivals
+                pub.end()
+                barrier.add_event("contribution", worker=w)
+                wr.end()
+            if kill_worker_mid_round is not None \
+                    and rnd >= kill_worker_mid_round:
+                barrier.add_event("buried", worker="w1")
+            barrier.end()
+            if kill_worker_mid_round is not None \
+                    and rnd == kill_worker_mid_round:
+                # master still commits on the survivor set
+                pass
+            round_sp.end()
+        run.end()
+        return d
+
+    def test_merged_timeline_and_attribution(self, tmp_path,
+                                             no_global_tracer):
+        self._fake_elastic_trace(tmp_path)
+        spans = load_trace_dir(str(tmp_path))
+        timeline = build_timeline(spans)
+        assert timeline["processes"] == ["master", "w0", "w1"]
+        rounds = timeline["rounds"]
+        assert [r["round"] for r in rounds] == [0, 1, 2]
+        for r in rounds:
+            assert r["status"] == "committed"
+            # w1's publish is staggered later → it is the straggler
+            assert r["straggler"] == "w1"
+            assert r["straggler_wait_ms"] > 0
+            waited = {a["worker"]: a["waited_ms"] for a in r["contributors"]}
+            assert waited["w1"] == 0.0 and waited["w0"] > 0
+
+    def test_partial_round_from_kill(self, tmp_path, no_global_tracer):
+        self._fake_elastic_trace(tmp_path, kill_worker_mid_round=1)
+        spans = load_trace_dir(str(tmp_path))
+        timeline = build_timeline(spans)
+        r1 = [r for r in timeline["rounds"] if r["round"] == 1][0]
+        # the survivor's contribution still committed the round, but the
+        # victim's unclosed spans are visible on it
+        assert "w1:worker.round" in r1["open_spans"]
+        assert [a["worker"] for a in r1["contributors"]] == ["w0"]
+        assert timeline["n_open"] >= 1
+
+    def test_chrome_export_schema(self, tmp_path, no_global_tracer):
+        self._fake_elastic_trace(tmp_path, kill_worker_mid_round=2)
+        spans = load_trace_dir(str(tmp_path))
+        out = chrome_trace(spans)
+        events = out["traceEvents"]
+        meta = [e for e in events if e["ph"] == "M"]
+        assert {m["args"]["name"] for m in meta} == {"master", "w0", "w1"}
+        xs = [e for e in events if e["ph"] == "X"]
+        assert len(xs) == len(spans)
+        for e in xs:
+            assert e["dur"] >= 0 and e["ts"] > 0
+            assert isinstance(e["pid"], int)
+        # the victim's unclosed span is flagged open in its args
+        assert any(e["args"].get("open") for e in xs)
+        json.dumps(out)  # valid JSON end to end
+
+    def test_cli(self, tmp_path, no_global_tracer):
+        self._fake_elastic_trace(tmp_path)
+        chrome_path = str(tmp_path / "chrome.json")
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "trace_report.py"),
+             str(tmp_path), "--chrome", chrome_path],
+            capture_output=True, text=True, timeout=60, cwd=REPO)
+        assert out.returncode == 0, out.stderr
+        assert "committed" in out.stdout
+        assert "waited on" in out.stdout
+        assert os.path.exists(chrome_path)
+        out2 = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "trace_report.py"),
+             str(tmp_path), "--json"],
+            capture_output=True, text=True, timeout=60, cwd=REPO)
+        tl = json.loads(out2.stdout)
+        assert len(tl["rounds"]) == 3
+
+    def test_cli_missing_dir_exits_2(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "trace_report.py"),
+             str(tmp_path / "nope")],
+            capture_output=True, text=True, timeout=60, cwd=REPO)
+        assert out.returncode == 2
+        assert "no such trace dir" in out.stderr
+
+    def test_torn_tail_line_tolerated(self, tmp_path, no_global_tracer):
+        t = tr.Tracer("p0", trace_dir=str(tmp_path),
+                      registry=MetricsRegistry())
+        with t.span("complete"):
+            pass
+        with open(tmp_path / "spans_p0.jsonl", "a") as fh:
+            fh.write('{"ev": "B", "span_id": "torn')  # killed mid-write
+        spans = load_trace_dir(str(tmp_path))
+        assert len(spans) == 1  # the complete span survives, tail skipped
+
+
+class TestBenchReport:
+    def _write_round(self, d, n, value, detail=None, parsed=True, tail=""):
+        rec = {"n": n, "cmd": "bench", "rc": 0, "tail": tail,
+               "parsed": ({"metric": "m", "value": value, "unit": "x",
+                           "detail": detail or {}} if parsed else None)}
+        (d / f"BENCH_r{n:02d}.json").write_text(json.dumps(rec))
+
+    def test_trajectory_and_regression_flag(self, tmp_path):
+        self._write_round(tmp_path, 1, 100.0,
+                          {"mlp_bf16_samples_per_sec": 1000.0})
+        self._write_round(tmp_path, 2, 110.0,
+                          {"mlp_bf16_samples_per_sec": 800.0,
+                           "moe_tokens_per_sec": 50.0})
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "bench_report.py"),
+             "--dir", str(tmp_path), "--json"],
+            capture_output=True, text=True, timeout=60, cwd=REPO)
+        assert out.returncode == 0, out.stderr
+        traj = json.loads(out.stdout)
+        regs = {r["metric"] for r in traj["regressions"]}
+        assert regs == {"mlp_bf16_samples_per_sec"}  # -20% flagged
+        row = [r for r in traj["table"]
+               if r["metric"] == "mlp_bf16_samples_per_sec"][0]
+        assert row["delta_pct"] == -20.0 and row["regression"]
+        # fail-on-regression turns the flag into exit 1
+        out2 = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "bench_report.py"),
+             "--dir", str(tmp_path), "--fail-on-regression"],
+            capture_output=True, text=True, timeout=60, cwd=REPO)
+        assert out2.returncode == 1
+        assert "REGRESSION" in out2.stdout
+
+    def test_unparsed_round_recovered_from_tail(self, tmp_path):
+        self._write_round(tmp_path, 1, 100.0,
+                          {"word2vec_words_per_sec": 500.0})
+        self._write_round(
+            tmp_path, 2, None, parsed=False,
+            tail='...clipped... "word2vec_words_per_sec": 600.0, '
+                 '"word2vec_host_device_split": {"host_pairgen_s": 0.0}}')
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "bench_report.py"),
+             "--dir", str(tmp_path), "--json"],
+            capture_output=True, text=True, timeout=60, cwd=REPO)
+        traj = json.loads(out.stdout)
+        assert traj["rounds"][1]["source"] == "partial"
+        row = [r for r in traj["table"]
+               if r["metric"] == "word2vec_words_per_sec"][0]
+        assert dict((n, v) for n, v in row["series"])[2] == 600.0
+        assert row["delta_pct"] == 20.0
+
+    def test_runs_on_real_repo_artifacts(self):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "bench_report.py")],
+            capture_output=True, text=True, timeout=60, cwd=REPO)
+        assert out.returncode == 0, out.stderr
+        assert "bench trajectory" in out.stdout
+
+    def test_empty_dir_exits_2(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "bench_report.py"),
+             "--dir", str(tmp_path)],
+            capture_output=True, text=True, timeout=60, cwd=REPO)
+        assert out.returncode == 2

@@ -2,6 +2,7 @@
 tsne coords, weights)."""
 
 import json
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -175,3 +176,37 @@ def test_weight_histograms_helper():
     h = hists["layer0/W"]
     assert len(h["counts"]) == 10 and len(h["edges"]) == 11
     assert sum(h["counts"]) == 4 * 3
+
+
+def test_api_trace_endpoint(server, tmp_path):
+    """ISSUE 7: /api/trace serves the attached tracer's flight-recorder
+    ring — open spans with elapsed durations + recent ended spans — and
+    404s cleanly when no tracer is attached anywhere."""
+    from deeplearning4j_tpu.telemetry import trace as tr
+    from deeplearning4j_tpu.telemetry.registry import MetricsRegistry
+
+    prev = tr.set_tracer(None)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(server, "/api/trace")
+        assert exc.value.code == 404
+
+        tracer = tr.Tracer("ui-proc", trace_dir=str(tmp_path / "trace"),
+                           registry=MetricsRegistry())
+        server.attach_tracer(tracer)
+        with tracer.span("finished-op", attrs={"round": 1}):
+            pass
+        open_span = tracer.start_span("live-op", attrs={"round": 2})
+        status, body = _get(server, "/api/trace")
+        assert status == 200
+        snap = json.loads(body)
+        assert snap["process"] == "ui-proc"
+        assert [s["name"] for s in snap["open"]] == ["live-op"]
+        assert snap["open"][0]["dur_ms"] >= 0
+        assert any(r["name"] == "finished-op" for r in snap["recent"])
+        open_span.end()
+
+        status, body = _get(server, "/api/trace?limit=1")
+        assert len(json.loads(body)["recent"]) == 1
+    finally:
+        tr.set_tracer(prev)

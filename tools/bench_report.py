@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Aggregate the per-round ``BENCH_r*.json`` artifacts into a per-stage
+trajectory table with regression flagging.
+
+Usage:
+    python tools/bench_report.py [--dir REPO] [--json]
+                                 [--threshold PCT] [--fail-on-regression]
+
+Each round's driver snapshot is ``{n, cmd, rc, tail, parsed}`` where
+``parsed`` is bench.py's summary line (``{metric, value, detail: {...}}``).
+Some rounds have ``parsed: null`` (driver timeout, or a tail that
+truncated the summary line — round 2's rc=124, round 5's clipped tail);
+those are **recovered** where possible by regexing stage-metric keys out
+of the tail fragment, and flagged ``partial`` rather than silently
+dropped — a missing round must never read as "no regression".
+
+The table shows one row per stage metric (``*_per_sec``, ``*_mfu``,
+ratio keys), one column per round, plus the delta of the latest value vs
+the previous round that has one. Deltas below ``-threshold`` (default
+10%) are flagged as regressions; ``--fail-on-regression`` turns them into
+exit code 1 for CI use. ``--json`` emits the raw structure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# stage metrics worth tracking round over round: rates, MFU, A/B ratios
+_METRIC_RE = re.compile(
+    r"_(?:per_sec|per_chip|mfu|vs_cpu|vs_single|vs_densecore|vs_baseline|"
+    r"blocking_vs_background|overhead_pct)$")
+# recovery regex for a truncated tail: top-level "key": number pairs
+_TAIL_PAIR_RE = re.compile(
+    r'"([a-z0-9_]+)":\s*(-?[0-9]+(?:\.[0-9]+)?(?:[eE][-+]?[0-9]+)?)')
+
+
+def _is_metric_key(key: str) -> bool:
+    return bool(_METRIC_RE.search(key))
+
+
+def _recover_from_tail(tail: str) -> Dict[str, float]:
+    """Best-effort stage metrics from a clipped output tail."""
+    out: Dict[str, float] = {}
+    for key, val in _TAIL_PAIR_RE.findall(tail or ""):
+        if _is_metric_key(key):
+            out[key] = float(val)  # last occurrence wins (closest to end)
+    return out
+
+
+def load_rounds(bench_dir: str) -> List[Dict]:
+    """One record per BENCH_r*.json: {round, source, metrics, headline}."""
+    rounds = []
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_r*.json"))):
+        m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as fh:
+                rec = json.load(fh)
+        except (OSError, json.JSONDecodeError) as exc:
+            rounds.append({"round": int(m.group(1)), "source": "unreadable",
+                           "error": str(exc), "metrics": {},
+                           "headline": None})
+            continue
+        parsed = rec.get("parsed")
+        if isinstance(parsed, dict):
+            detail = parsed.get("detail") or {}
+            metrics = {k: float(v) for k, v in detail.items()
+                       if _is_metric_key(k) and isinstance(v, (int, float))}
+            rounds.append({"round": int(m.group(1)), "source": "parsed",
+                           "metrics": metrics,
+                           "headline": parsed.get("value")})
+        else:
+            metrics = _recover_from_tail(rec.get("tail", ""))
+            rounds.append({"round": int(m.group(1)), "source": "partial",
+                           "metrics": metrics,
+                           "headline": metrics.get("value")})
+    rounds.sort(key=lambda r: r["round"])
+    return rounds
+
+
+def build_trajectory(rounds: List[Dict], threshold_pct: float = 10.0
+                     ) -> Dict:
+    """Per-metric series across rounds + latest-vs-previous deltas."""
+    keys = sorted({k for r in rounds for k in r["metrics"]})
+    table = []
+    regressions = []
+    for key in keys:
+        series = [(r["round"], r["metrics"].get(key)) for r in rounds]
+        present = [(n, v) for n, v in series if v is not None]
+        delta_pct: Optional[float] = None
+        if len(present) >= 2:
+            (prev_n, prev), (last_n, last) = present[-2], present[-1]
+            if prev:
+                delta_pct = round((last - prev) / abs(prev) * 100.0, 2)
+        row = {"metric": key, "series": series, "delta_pct": delta_pct,
+               "regression": (delta_pct is not None
+                              and delta_pct < -threshold_pct)}
+        if row["regression"]:
+            regressions.append({"metric": key, "delta_pct": delta_pct,
+                                "from_round": present[-2][0],
+                                "to_round": present[-1][0]})
+        table.append(row)
+    return {
+        "rounds": [{"round": r["round"], "source": r["source"],
+                    "headline": r["headline"],
+                    "n_metrics": len(r["metrics"])} for r in rounds],
+        "headline_series": [(r["round"], r["headline"]) for r in rounds],
+        "threshold_pct": threshold_pct,
+        "table": table,
+        "regressions": regressions,
+    }
+
+
+def render_text(traj: Dict) -> str:
+    round_ids = [r["round"] for r in traj["rounds"]]
+    lines = ["bench trajectory — rounds " +
+             ", ".join(f"r{r['round']}({r['source']})"
+                       for r in traj["rounds"])]
+    head = ", ".join(f"r{n}={v}" if v is not None else f"r{n}=?"
+                     for n, v in traj["headline_series"])
+    lines.append(f"headline (mnist mlp samples/s/chip): {head}")
+    if not traj["table"]:
+        return "\n".join(lines + ["no stage metrics found"])
+    width = max(len(row["metric"]) for row in traj["table"])
+    cols = "  ".join(f"{('r%d' % n):>10}" for n in round_ids)
+    lines += ["", f"{'metric':<{width}}  {cols}  {'Δ last %':>9}  flag"]
+    for row in traj["table"]:
+        vals = {n: v for n, v in row["series"]}
+        cells = "  ".join(
+            f"{vals[n]:>10.1f}" if vals.get(n) is not None else f"{'-':>10}"
+            for n in round_ids)
+        delta = (f"{row['delta_pct']:>+9.1f}"
+                 if row["delta_pct"] is not None else f"{'-':>9}")
+        flag = "REGRESSION" if row["regression"] else ""
+        lines.append(f"{row['metric']:<{width}}  {cells}  {delta}  {flag}")
+    if traj["regressions"]:
+        lines += ["", f"{len(traj['regressions'])} regression(s) worse than "
+                  f"-{traj['threshold_pct']}% vs previous round:"]
+        lines += [f"  {r['metric']}: {r['delta_pct']}% "
+                  f"(r{r['from_round']} -> r{r['to_round']})"
+                  for r in traj["regressions"]]
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=REPO_ROOT,
+                    help="directory holding BENCH_r*.json (default: repo)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the trajectory as JSON")
+    ap.add_argument("--threshold", type=float, default=10.0,
+                    help="flag deltas below -PCT%% as regressions")
+    ap.add_argument("--fail-on-regression", action="store_true",
+                    help="exit 1 when any metric regressed past threshold")
+    args = ap.parse_args(argv)
+    rounds = load_rounds(args.dir)
+    if not rounds:
+        print(f"no BENCH_r*.json files under {args.dir}", file=sys.stderr)
+        return 2
+    traj = build_trajectory(rounds, threshold_pct=args.threshold)
+    if args.json:
+        print(json.dumps(traj, indent=1))
+    else:
+        print(render_text(traj))
+    if args.fail_on_regression and traj["regressions"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

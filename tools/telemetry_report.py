@@ -61,7 +61,17 @@ def main(argv=None) -> int:
     if not os.path.isfile(args.log):
         print(f"no such step log: {args.log}", file=sys.stderr)
         return 2
-    records = read_step_log(args.log)
+    try:
+        records = read_step_log(args.log)
+    except ValueError as exc:
+        # truncated (writer killed mid-line) or corrupt log: a clear
+        # message naming the bad line, not a traceback
+        print(str(exc), file=sys.stderr)
+        return 3
+    if not records:
+        print(f"step log {args.log} is empty (no step records)",
+              file=sys.stderr)
+        return 3
     summary = summarize_step_log(records)
     if args.json:
         print(json.dumps(summary, indent=1))

@@ -1,0 +1,289 @@
+#!/usr/bin/env python
+"""Merge per-process trace span files into one round timeline.
+
+Usage:
+    python tools/trace_report.py TRACE_DIR [--json] [--chrome OUT.json]
+
+Reads every ``spans_*.jsonl`` the telemetry.trace tracers wrote under
+``TRACE_DIR`` (master + workers of an elastic run) plus any
+``flightrec_*.json`` flight-recorder dumps, pairs begin/end records into
+spans (an unmatched begin — a process that died mid-span — becomes an
+*open* span), and renders:
+
+- the merged **round timeline**: per elastic round, duration, who
+  contributed, and the **barrier-wait attribution** — which worker the
+  round waited on and for how long after the first contribution arrived
+  (from the master barrier span's ``contribution`` events, falling back
+  to worker ``worker.publish`` span end times when the master file is
+  missing);
+- partial rounds reconstructed from open spans (a kill -9 run shows the
+  round the victim died in, with the spans it never closed);
+- ``--chrome``: a Chrome trace-event JSON export (load in
+  ``chrome://tracing`` / Perfetto) with one row per process.
+
+The aggregation is importable (``load_trace_dir`` / ``build_timeline`` /
+``chrome_trace``) so bench.py's traced-elastic stage and the fault tests
+use the exact same reconstruction this CLI prints.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _merge_begin(spans: Dict[str, Dict], rec: Dict) -> None:
+    sp = spans.setdefault(rec["span_id"], {})
+    sp.update({
+        "span_id": rec["span_id"], "trace_id": rec.get("trace_id"),
+        "parent_id": rec.get("parent_id"), "name": rec.get("name"),
+        "process": rec.get("process"), "start": rec.get("ts"),
+        "attrs": {**rec.get("attrs", {}), **sp.get("attrs", {})},
+    })
+    sp.setdefault("status", "open")
+    sp.setdefault("events", [])
+
+
+def _merge_end(spans: Dict[str, Dict], rec: Dict) -> None:
+    sp = spans.setdefault(rec["span_id"], {})
+    sp.update({
+        "span_id": rec["span_id"],
+        "trace_id": rec.get("trace_id", sp.get("trace_id")),
+        "name": rec.get("name", sp.get("name")),
+        "process": rec.get("process", sp.get("process")),
+        "end": rec.get("ts"), "dur_ms": rec.get("dur_ms"),
+        "status": rec.get("status", "ok"), "error": rec.get("error"),
+        "attrs": {**sp.get("attrs", {}), **rec.get("attrs", {})},
+        "events": rec.get("events", sp.get("events", [])),
+    })
+    if sp.get("start") is None and rec.get("dur_ms") is not None:
+        sp["start"] = rec["ts"] - rec["dur_ms"] / 1000.0
+
+
+def load_trace_dir(trace_dir: str) -> Dict[str, Dict]:
+    """All spans under ``trace_dir`` keyed by span_id. Tolerant of a
+    truncated trailing line (a process killed mid-write) — everything
+    parseable is kept, the torn tail is skipped."""
+    spans: Dict[str, Dict] = {}
+    for path in sorted(glob.glob(os.path.join(trace_dir, "spans_*.jsonl"))):
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail from a killed process
+                if rec.get("ev") == "B":
+                    _merge_begin(spans, rec)
+                elif rec.get("ev") == "E":
+                    _merge_end(spans, rec)
+    # flight dumps can carry spans whose jsonl never made it (e.g. a sink
+    # on a dead NFS mount) — merge, never overwrite fresher jsonl data
+    for path in sorted(glob.glob(os.path.join(trace_dir, "flightrec_*.json"))):
+        try:
+            with open(path) as fh:
+                dump = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        for rec in dump.get("recent", []):
+            if rec.get("span_id") not in spans:
+                _merge_end(spans, rec)
+        for sp in dump.get("open", []):
+            if sp.get("span_id") not in spans:
+                spans[sp["span_id"]] = {**sp, "status": "open",
+                                        "events": sp.get("events", [])}
+    return spans
+
+
+def _arrivals(round_info: Dict) -> List[Dict]:
+    """Per-worker contribution arrival times for one round, preferring the
+    master barrier span's events (one clock — the master's) and falling
+    back to worker publish span ends."""
+    by_worker: Dict[str, float] = {}
+    barrier = round_info.get("barrier")
+    if barrier:
+        for ev in barrier.get("events", []):
+            if ev.get("name") == "contribution" and ev.get("worker"):
+                by_worker.setdefault(str(ev["worker"]), float(ev["ts"]))
+    for sp in round_info.get("publishes", []):
+        ts = sp.get("end") or sp.get("start")
+        w = str(sp.get("attrs", {}).get("worker", sp.get("process")))
+        if ts is not None:
+            by_worker.setdefault(w, float(ts))
+    return [{"worker": w, "ts": ts}
+            for w, ts in sorted(by_worker.items(), key=lambda kv: kv[1])]
+
+
+def build_timeline(spans: Dict[str, Dict]) -> Dict:
+    """Group spans into elastic rounds with barrier-wait attribution."""
+    rounds: Dict[int, Dict] = {}
+
+    def rnd_of(sp) -> Optional[int]:
+        r = sp.get("attrs", {}).get("round")
+        return int(r) if r is not None else None
+
+    for sp in spans.values():
+        r, name = rnd_of(sp), sp.get("name")
+        if r is None:
+            continue
+        info = rounds.setdefault(r, {"publishes": [], "worker_rounds": []})
+        if name == "elastic.round":
+            info["master"] = sp
+        elif name == "elastic.barrier":
+            info["barrier"] = sp
+        elif name == "worker.publish":
+            info["publishes"].append(sp)
+        elif name == "worker.round":
+            info["worker_rounds"].append(sp)
+
+    out_rounds = []
+    for r in sorted(rounds):
+        info = rounds[r]
+        master = info.get("master")
+        committed = master is not None and master.get("end") is not None
+        arrivals = _arrivals(info)
+        if committed and not arrivals and not info["worker_rounds"] \
+                and "barrier" not in info:
+            # the final published version whose round was never collected
+            # (the run ended there) — not a committed round, not a crash
+            status = "uncollected"
+        else:
+            status = "committed" if committed else "partial"
+        row: Dict = {
+            "round": r,
+            "status": status,
+            "contributors": arrivals,
+            "workers_seen": sorted({
+                str(sp.get("attrs", {}).get("worker", sp.get("process")))
+                for sp in info["worker_rounds"] + info["publishes"]}),
+            "open_spans": sorted({
+                f"{sp.get('process')}:{sp.get('name')}"
+                for group in (info["worker_rounds"], info["publishes"])
+                for sp in group if sp.get("status") == "open"}
+                | ({f"{master.get('process')}:{master.get('name')}"}
+                   if master is not None and not committed else set())),
+        }
+        if master is not None:
+            row["start"] = master.get("start")
+            if committed:
+                row["dur_ms"] = master.get("dur_ms")
+        if arrivals:
+            first, last = arrivals[0], arrivals[-1]
+            row["straggler"] = last["worker"]
+            row["straggler_wait_ms"] = round(
+                (last["ts"] - first["ts"]) * 1000.0, 3)
+            for a in arrivals:
+                a["waited_ms"] = round((last["ts"] - a["ts"]) * 1000.0, 3)
+        out_rounds.append(row)
+
+    processes = sorted({sp.get("process") for sp in spans.values()
+                        if sp.get("process")})
+    n_open = sum(1 for sp in spans.values() if sp.get("status") == "open")
+    errors = [{"process": sp.get("process"), "name": sp.get("name"),
+               "error": sp.get("error")}
+              for sp in spans.values() if sp.get("status") == "error"]
+    return {"processes": processes, "n_spans": len(spans),
+            "n_open": n_open, "errors": errors, "rounds": out_rounds}
+
+
+def chrome_trace(spans: Dict[str, Dict]) -> Dict:
+    """Chrome trace-event JSON (the ``chrome://tracing`` / Perfetto
+    format): one "X" complete event per span in µs, one row per process,
+    open spans extended to the latest timestamp seen and flagged."""
+    processes = sorted({sp.get("process") or "?" for sp in spans.values()})
+    pid_of = {p: i for i, p in enumerate(processes)}
+    latest = max((sp.get("end") or sp.get("start") or 0.0
+                  for sp in spans.values()), default=0.0)
+    events: List[Dict] = [
+        {"name": "process_name", "ph": "M", "pid": pid_of[p], "tid": 0,
+         "args": {"name": p}}
+        for p in processes
+    ]
+    for sp in sorted(spans.values(), key=lambda s: s.get("start") or 0.0):
+        start = sp.get("start")
+        if start is None:
+            continue
+        is_open = sp.get("end") is None
+        end = sp.get("end") if not is_open else latest
+        args = dict(sp.get("attrs", {}))
+        args.update({"span_id": sp.get("span_id"),
+                     "trace_id": sp.get("trace_id"),
+                     "status": sp.get("status")})
+        if is_open:
+            args["open"] = True
+        if sp.get("error"):
+            args["error"] = sp["error"]
+        events.append({
+            "name": sp.get("name") or "?", "ph": "X",
+            "ts": round(start * 1e6, 1),
+            "dur": round(max(0.0, (end - start)) * 1e6, 1),
+            "pid": pid_of[sp.get("process") or "?"], "tid": 0,
+            "args": args,
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def render_text(timeline: Dict, trace_dir: str) -> str:
+    lines = [f"trace report — {trace_dir}",
+             f"processes: {', '.join(timeline['processes'])} "
+             f"({timeline['n_spans']} spans, {timeline['n_open']} open)"]
+    if timeline["errors"]:
+        lines.append("errors:")
+        lines += [f"  {e['process']}:{e['name']}  {e['error']}"
+                  for e in timeline["errors"]]
+    hdr = (f"{'round':>5}  {'status':<9}  {'dur_ms':>9}  "
+           f"{'contrib':<24}  {'waited on':<12}  {'wait_ms':>8}")
+    lines += ["", hdr, "-" * len(hdr)]
+    for row in timeline["rounds"]:
+        contrib = ",".join(a["worker"] for a in row["contributors"]) or "-"
+        dur = (f"{row['dur_ms']:.1f}" if row.get("dur_ms") is not None
+               else "-")
+        lines.append(
+            f"{row['round']:>5}  {row['status']:<9}  {dur:>9}  "
+            f"{contrib:<24}  {row.get('straggler', '-'):<12}  "
+            f"{row.get('straggler_wait_ms', 0.0):>8}")
+        if row["open_spans"]:
+            # a committed round can still carry a dead worker's unclosed
+            # spans (kill -9 mid-round, survivors committed without it)
+            lines.append(f"{'':>5}  open: {', '.join(row['open_spans'])}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace_dir", help="directory of spans_*.jsonl files")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the merged timeline as JSON")
+    ap.add_argument("--chrome", metavar="OUT",
+                    help="also write a Chrome trace-event JSON export")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.trace_dir):
+        print(f"no such trace dir: {args.trace_dir}", file=sys.stderr)
+        return 2
+    spans = load_trace_dir(args.trace_dir)
+    if not spans:
+        print(f"no span records under {args.trace_dir} "
+              "(expected spans_*.jsonl / flightrec_*.json)", file=sys.stderr)
+        return 2
+    timeline = build_timeline(spans)
+    if args.chrome:
+        with open(args.chrome, "w") as fh:
+            json.dump(chrome_trace(spans), fh)
+        print(f"chrome trace written: {args.chrome}", file=sys.stderr)
+    if args.json:
+        print(json.dumps(timeline, indent=1))
+    else:
+        print(render_text(timeline, args.trace_dir))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
