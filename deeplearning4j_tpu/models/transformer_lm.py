@@ -371,7 +371,7 @@ def shard_lm_batch(tokens: Array, targets: Array, mesh: Mesh) -> tuple:
 
 
 def _make_sgd_step(loss_fn, lr: float, with_metrics: bool,
-                   donate: bool = False):
+                   donate: bool = False, guard=None):
     """jitted SGD step; with metrics the loss fn returns (loss, aux) and the
     step appends the grad/param-norm block — the loss+grad graph itself is
     the SAME ops either way (bit-parity pinned in tests/test_telemetry.py).
@@ -379,14 +379,37 @@ def _make_sgd_step(loss_fn, lr: float, with_metrics: bool,
     ``donate=True`` donates the incoming params buffers to the update
     (halves peak param HBM for hot training loops: bench); the default
     keeps them alive because parity oracles and tests call the step with a
-    pytree they reuse afterwards."""
+    pytree they reuse afterwards.
+
+    ``guard`` (a ``GuardConfig``; see optimize/guardrails.py) swaps the
+    plain SGD update for the guarded one — skip-on-nonfinite (params
+    carried unchanged through a NaN/Inf step via an in-graph select) and
+    optional global-norm clipping. A guarded step returns its guard block
+    (``nonfinite``/``clipped``/``guard_grad_norm`` device scalars) as a
+    third output, or merged into the metrics dict when ``with_metrics``;
+    on clean batches it is bit-identical to the unguarded step (pinned in
+    tests/test_guardrails.py) and remains donate-safe."""
     donate_argnums = (0,) if donate else ()
+    if guard is not None:
+        from deeplearning4j_tpu.optimize.guardrails import guarded_sgd_update
     if not with_metrics:
+        if guard is None:
+            @partial(jax.jit, donate_argnums=donate_argnums)
+            def step(params, tokens, targets):
+                loss, grads = jax.value_and_grad(loss_fn)(params, tokens,
+                                                          targets)
+                return jax.tree_util.tree_map(lambda p, g: p - lr * g,
+                                              params, grads), loss
+
+            return step
+
         @partial(jax.jit, donate_argnums=donate_argnums)
         def step(params, tokens, targets):
-            loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
-            return jax.tree_util.tree_map(lambda p, g: p - lr * g,
-                                          params, grads), loss
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens,
+                                                      targets)
+            new_params, gm = guarded_sgd_update(params, grads, loss, lr,
+                                                guard)
+            return new_params, loss, gm
 
         return step
 
@@ -396,10 +419,16 @@ def _make_sgd_step(loss_fn, lr: float, with_metrics: bool,
     def step(params, tokens, targets):
         (loss, metrics), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params, tokens, targets)
-        new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g,
-                                            params, grads)
+        if guard is None:
+            new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g,
+                                                params, grads)
+            gm = {}
+        else:
+            new_params, gm = guarded_sgd_update(params, grads, loss, lr,
+                                                guard)
         metrics = {**metrics,
-                   **train_step_metrics(params, grads, lr, loss=loss)}
+                   **train_step_metrics(params, grads, lr, loss=loss),
+                   **gm}
         return new_params, loss, metrics
 
     return step
@@ -411,7 +440,7 @@ def make_composed_train_step(mesh: Mesh, n_heads: int, capacity: int,
                              attn_impl: Optional[str] = None,
                              moe_impl: Optional[str] = None,
                              with_metrics: bool = False,
-                             donate: bool = False):
+                             donate: bool = False, guard=None):
     """SGD step over the composed mesh: step(params, tokens, targets) ->
     (new_params, loss). Shard inputs with shard_lm_params/shard_lm_batch
     first; GSPMD + the shard_map transposes insert every collective
@@ -423,25 +452,37 @@ def make_composed_train_step(mesh: Mesh, n_heads: int, capacity: int,
     is an in-graph dict (loss, task/aux split, grad_norm, param_norm,
     update_ratio, (E,) router_load summing to 1, moe_dropped_frac) of
     DEVICE scalars — feed it to telemetry.TrainTelemetry.record, which
-    fetches every N steps so the hot path stays one dispatch."""
+    fetches every N steps so the hot path stays one dispatch.
+
+    ``guard=True`` (or a ``GuardConfig``) arms the numerical guardrails:
+    skip-on-nonfinite + optional global-norm clip inside the same jitted
+    program, returning the guard block as a third output (merged into
+    metrics when ``with_metrics``); see optimize/guardrails.py."""
+    from deeplearning4j_tpu.optimize.guardrails import GuardConfig
+
     loss_fn = composed_loss_fn(mesh, n_heads, capacity, top_k, aux_weight,
                                attn_impl=attn_impl, moe_impl=moe_impl,
                                with_metrics=with_metrics)
-    return _make_sgd_step(loss_fn, lr, with_metrics, donate=donate)
+    return _make_sgd_step(loss_fn, lr, with_metrics, donate=donate,
+                          guard=GuardConfig.coerce(guard))
 
 
 def make_single_device_train_step(n_heads: int, lr: float = 0.1,
                                   top_k: int = 2, aux_weight: float = 1e-2,
                                   attn_impl: Optional[str] = None,
                                   with_metrics: bool = False,
-                                  donate: bool = False):
+                                  donate: bool = False, guard=None):
     """The dense twin of make_composed_train_step (parity oracle when
     called with ``attn_impl="dense"``; the flagship single-chip bench path
-    with the default auto core). ``with_metrics``/``donate`` as on the
-    composed builder (bench hot loops pass donate=True)."""
+    with the default auto core). ``with_metrics``/``donate``/``guard`` as
+    on the composed builder (bench hot loops pass donate=True; the
+    guardrails bench stage passes guard=True on top)."""
+    from deeplearning4j_tpu.optimize.guardrails import GuardConfig
+
     loss_fn = dense_loss_fn(n_heads, top_k, aux_weight, attn_impl=attn_impl,
                             with_metrics=with_metrics)
-    return _make_sgd_step(loss_fn, lr, with_metrics, donate=donate)
+    return _make_sgd_step(loss_fn, lr, with_metrics, donate=donate,
+                          guard=GuardConfig.coerce(guard))
 
 
 # ----------------------------------------------------------------- dp×pp ----
@@ -532,6 +573,29 @@ def make_pp_loss(stage_fn, mesh: Mesh, pipe_axis: str,
         return jnp.mean(nll)
 
     return loss
+
+
+def lm_replay(n_heads: int, top_k: int = 2, aux_weight: float = 1e-2,
+              attn_impl: Optional[str] = None):
+    """``tools/step_replay.py`` factory for flagship-LM replay bundles
+    (``--factory deeplearning4j_tpu.models.transformer_lm:lm_replay``).
+
+    Returns ``run(payload) -> dict`` re-executing the faulting step's loss
+    + grad from a bundle whose payload is ``{"params": <lm params>,
+    "batch": {"tokens", "targets"}}`` — deterministic (the forward has no
+    RNG), so a non-finite loss reproduces exactly."""
+    loss_fn = dense_loss_fn(n_heads, top_k, aux_weight, attn_impl=attn_impl)
+
+    def run(payload: dict) -> dict:
+        from deeplearning4j_tpu.telemetry.metrics import global_norm
+
+        params = jax.tree_util.tree_map(jnp.asarray, payload["params"])
+        toks = jnp.asarray(payload["batch"]["tokens"], jnp.int32)
+        tgts = jnp.asarray(payload["batch"]["targets"], jnp.int32)
+        loss, grads = jax.value_and_grad(loss_fn)(params, toks, tgts)
+        return {"loss": float(loss), "grad_norm": float(global_norm(grads))}
+
+    return run
 
 
 def pp_trained_to_lm_params(trained) -> dict:

@@ -1,2 +1,7 @@
 from deeplearning4j_tpu.optimize.updater import UpdaterState, init_updater_state, apply_updater  # noqa: F401
 from deeplearning4j_tpu.optimize.solver import Solver  # noqa: F401
+from deeplearning4j_tpu.optimize.guardrails import (  # noqa: F401
+    DivergenceWatchdog,
+    GuardConfig,
+    guarded_sgd_update,
+)

@@ -1,0 +1,476 @@
+"""Numerical-fault guardrails (ISSUE 8): detect, skip, clip, roll back,
+explain.
+
+PRs 6-7 made training survive *process* faults; this module survives
+*numerical* ones. A single NaN/Inf gradient silently corrupts params — and
+under elastic parameter averaging one poisoned worker contaminates every
+survivor at the next sync round. The reference lineage treats non-finite
+scores as hard failures (utils/sloppy_math.is_dangerous, the solver's
+NaN-aware backtracking); the modern equivalent has four layers:
+
+1. **In-graph guard** (``guarded_sgd_update`` / ``guard_stats`` /
+   ``clip_by_global_norm``): inside the jitted step, compute loss + grad
+   global-norm finiteness, optionally clip by global norm, and apply
+   **skip-on-nonfinite** — the updated params are selected against the
+   incoming params with ``jnp.where(finite, new, old)``, so a poisoned
+   batch costs one step of progress, never the model. The select is exact:
+   on a clean batch the guarded step is BIT-IDENTICAL (loss AND params) to
+   the unguarded step (pinned in tests/test_guardrails.py across
+   single-device, dp×ep, dp×sp×ep, dp×pp, and the DP-sync trainer step),
+   and it is donate-safe (the guard only adds reductions and selects on
+   values the step already has — no extra dispatch).
+
+2. **Guard seams**: every composed train step accepts ``guard=`` —
+   ``models/transformer_lm`` builders, ``parallel/pipeline.
+   make_pipeline_train_step``, ``parallel/trainer.make_sync_train_step``,
+   and ``scaleout/elastic.SyntheticRegressionModel(guard=True)`` —
+   mirroring the existing ``attn_impl``/``moe_impl``/``with_metrics``
+   seams. A guarded step returns its guard block (``nonfinite`` /
+   ``clipped`` / ``guard_grad_norm`` device scalars) either as a third
+   output or merged into the ``with_metrics`` dict.
+
+3. **Host watchdog** (``DivergenceWatchdog``): consumes the per-step guard
+   block + loss, counts ``guard_skipped_steps_total`` /
+   ``guard_clipped_steps_total`` and tracks ``guard_last_finite_loss``
+   through the PR 2 telemetry registry, and declares **divergence** on
+   either K consecutive skips or a finite-loss EMA spike. While healthy it
+   tags the most recent committed checkpoint ``last_good``
+   (``Checkpointer.mark_last_good`` — retention never collects that step);
+   on divergence ``rollback()`` restores it through
+   ``Checkpointer.restore``. On the first skip of a burst it dumps the
+   faulting step as a **replay bundle**.
+
+4. **Forensics** (``dump_replay_bundle`` / ``load_replay_bundle`` /
+   ``nonfinite_report``): the bundle is one atomic npz holding the
+   pre-step params + batch (+ meta: step id, RNG key, loss), replayed
+   deterministically by ``tools/step_replay.py``. The elastic master
+   additionally QUARANTINES any contribution whose tree fails
+   ``tree_all_finite`` before it can reach ``average_trees`` (see
+   scaleout/elastic.py).
+
+Zero-config is zero-cost: ``guard=None`` (the default everywhere) leaves
+every step byte-for-byte the code it was before this module existed.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import math
+import os
+import re
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.telemetry import trace as _trace
+
+log = logging.getLogger(__name__)
+
+_TINY = 1e-30  # clip-scale denominator floor (exact-1.0 scale stays exact)
+
+
+@dataclass(frozen=True)
+class GuardConfig:
+    """Static (trace-time) guard policy for one train step.
+
+    ``skip_nonfinite``: carry params unchanged through a step whose loss or
+    grad global-norm is NaN/Inf (the in-graph select). ``clip_norm``:
+    global-norm clip threshold applied to finite grads before the update
+    (None = no clipping). Both are Python statics — changing them builds a
+    new step, exactly like ``with_metrics``.
+    """
+
+    skip_nonfinite: bool = True
+    clip_norm: Optional[float] = None
+
+    @classmethod
+    def coerce(cls, guard) -> Optional["GuardConfig"]:
+        """Normalize the seam argument: None/False → no guard, True → the
+        default policy, a GuardConfig → itself."""
+        if guard is None or guard is False:
+            return None
+        if guard is True:
+            return cls()
+        if isinstance(guard, cls):
+            return guard
+        raise TypeError(
+            f"guard= must be None/False, True, or a GuardConfig; got "
+            f"{type(guard).__name__}")
+
+
+# ------------------------------------------------------------- in-graph ----
+
+def guard_stats(loss, grads) -> Tuple:
+    """(grad global-norm, finite?) — the two reductions every guard needs,
+    computed INSIDE the jitted step from intermediates it already has. A
+    single NaN/Inf anywhere in the grad tree poisons the norm, so one
+    scalar test covers every leaf."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.telemetry.metrics import global_norm
+
+    gn = global_norm(grads)
+    finite = jnp.logical_and(jnp.isfinite(jnp.asarray(loss, jnp.float32)),
+                             jnp.isfinite(gn))
+    return gn, finite
+
+
+def clip_by_global_norm(grads, grad_norm, clip_norm: float) -> Tuple:
+    """Scale ``grads`` so their global norm is at most ``clip_norm``.
+    Returns ``(grads, clipped?)``. Below the threshold the scale is exactly
+    1.0, so un-clipped steps stay bit-identical to the unguarded step."""
+    import jax
+    import jax.numpy as jnp
+
+    scale = jnp.minimum(jnp.float32(1.0),
+                        jnp.float32(clip_norm)
+                        / jnp.maximum(grad_norm, jnp.float32(_TINY)))
+    clipped = scale < jnp.float32(1.0)
+    grads = jax.tree_util.tree_map(
+        lambda g: g * scale.astype(g.dtype), grads)
+    return grads, clipped
+
+
+def guard_select(finite, new_tree, old_tree):
+    """Per-leaf ``where(finite, new, old)`` — the skip-on-nonfinite select.
+    ``finite`` is a replicated scalar, so under GSPMD the select is local
+    to every shard (no collective); the chosen operand passes through
+    bitwise, which is what makes the clean-batch guarantee exact."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(finite, n, o), new_tree, old_tree)
+
+
+def guarded_sgd_update(params, grads, loss, lr: float, cfg: GuardConfig
+                       ) -> Tuple:
+    """The guarded SGD update: ``(new_params, guard_metrics)``.
+
+    Clean batch → ``params - lr * grads`` bit-identical to the unguarded
+    update (clip scale is exactly 1.0 under the threshold; the skip select
+    passes the chosen operand through bitwise). Non-finite loss or grads →
+    params carried unchanged, ``nonfinite`` flag set. The metrics are f32
+    DEVICE scalars (``nonfinite``, ``clipped``, ``guard_grad_norm``) for
+    the host watchdog / telemetry session to fetch on its own cadence.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    gn, finite = guard_stats(loss, grads)
+    clipped = jnp.float32(0.0)
+    if cfg.clip_norm is not None:
+        grads, was_clipped = clip_by_global_norm(grads, gn, cfg.clip_norm)
+        clipped = jnp.logical_and(was_clipped, finite).astype(jnp.float32)
+    new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g,
+                                        params, grads)
+    if cfg.skip_nonfinite:
+        new_params = guard_select(finite, new_params, params)
+    metrics = {
+        "nonfinite": jnp.logical_not(finite).astype(jnp.float32),
+        "clipped": clipped,
+        "guard_grad_norm": gn,
+    }
+    return new_params, metrics
+
+
+# ------------------------------------------------------ host-side checks ----
+
+def tree_all_finite(tree) -> bool:
+    """Host-side: every float leaf of ``tree`` is finite. The elastic
+    master's pre-averaging quarantine gate (integer/bool leaves pass)."""
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(tree):
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating) or \
+                np.issubdtype(arr.dtype, np.complexfloating):
+            if not np.all(np.isfinite(arr)):
+                return False
+    return True
+
+
+def nonfinite_report(tree) -> List[Dict]:
+    """Per-leaf forensics: path, shape, dtype, non-finite count, and the
+    finite min/max — what ``tools/step_replay.py`` prints to point at the
+    poison source inside a bundle."""
+    import jax
+
+    out: List[Dict] = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        arr = np.asarray(leaf)
+        entry = {
+            "path": jax.tree_util.keystr(path),
+            "shape": list(arr.shape),
+            "dtype": str(arr.dtype),
+        }
+        if np.issubdtype(arr.dtype, np.floating):
+            finite = np.isfinite(arr)
+            n_bad = int(arr.size - int(finite.sum()))
+            entry["nonfinite"] = n_bad
+            if finite.any():
+                entry["finite_min"] = float(arr[finite].min())
+                entry["finite_max"] = float(arr[finite].max())
+        else:
+            entry["nonfinite"] = 0
+        out.append(entry)
+    return out
+
+
+# -------------------------------------------------------- replay bundles ----
+
+_KEY_SEG = re.compile(r"\['([^']*)'\]")
+
+
+def dump_replay_bundle(replay_dir: str, step: int, payload,
+                       meta: Optional[Dict] = None) -> str:
+    """Persist the faulting step as ONE atomic npz: ``payload`` is a
+    string-keyed-dict pytree of array leaves (conventionally
+    ``{"params": ..., "batch": {...}}``), ``meta`` is JSON-able context
+    (step id, RNG key as a list, loss, worker id). Returns the bundle
+    path — feed it to ``tools/step_replay.py``."""
+    from deeplearning4j_tpu.scaleout.elastic import tree_to_bytes
+
+    os.makedirs(replay_dir, exist_ok=True)
+    meta = dict(meta or {})
+    meta["step"] = int(step)
+    meta.setdefault("saved_unix", time.time())
+    path = os.path.join(replay_dir, f"replay_step_{int(step):08d}.npz")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as fh:
+        fh.write(tree_to_bytes(payload, meta))
+    os.replace(tmp, path)
+    return path
+
+
+def load_replay_bundle(path: str, template=None) -> Tuple[object, Dict]:
+    """Load ``(payload, meta)``. With ``template`` the strict
+    structure-checked path is used (elastic ``tree_from_bytes``); without
+    one the nested dicts are rebuilt from the stored keystr paths — enough
+    for forensics and for replay factories that index by key."""
+    from deeplearning4j_tpu.scaleout.elastic import tree_from_bytes
+
+    with open(path, "rb") as fh:
+        data = fh.read()
+    if template is not None:
+        return tree_from_bytes(data, template)
+    with np.load(io.BytesIO(data)) as z:
+        paths = json.loads(bytes(z["__paths__"]).decode())
+        meta = json.loads(bytes(z["__meta__"]).decode())
+        leaves = [np.asarray(z[f"leaf_{i}"]) for i in range(len(paths))]
+    tree: Dict = {}
+    for path_str, leaf in zip(paths, leaves):
+        keys = _KEY_SEG.findall(path_str)
+        if "".join(f"['{k}']" for k in keys) != path_str or not keys:
+            raise ValueError(
+                f"replay bundle {path}: unsupported leaf path {path_str!r} "
+                "(bundles hold string-keyed dict pytrees only)")
+        node = tree
+        for k in keys[:-1]:
+            node = node.setdefault(k, {})
+        node[keys[-1]] = leaf
+    return tree, meta
+
+
+# -------------------------------------------------------------- watchdog ----
+
+class DivergenceWatchdog:
+    """Host-side divergence policy over guarded-step telemetry.
+
+    Feed it one ``observe(step, loss, guard_metrics, ...)`` per train step
+    (values may be device scalars; the watchdog fetches them — call it on
+    whatever cadence the loop already syncs at). It returns a verdict:
+
+    - ``"ok"``      — finite loss, healthy trajectory;
+    - ``"skipped"`` — the in-graph guard skipped this step (non-finite);
+    - ``"clipped"`` — finite, but the global-norm clip engaged;
+    - ``"diverged"``— the run needs intervention: either
+      ``max_consecutive_skips`` skips in a row, or a finite loss above
+      ``spike_factor ×`` the loss EMA (after ``warmup_steps`` finite
+      observations).
+
+    While healthy, ``note_checkpoint(step)`` tags that committed step
+    ``last_good`` (``Checkpointer.mark_last_good`` — retention will never
+    collect it). After a ``"diverged"`` verdict, ``rollback(template[,
+    shardings])`` restores the ``last_good`` step through the normal
+    resharding restore path and resets the health state.
+
+    Registry signals (PR 2): ``guard_skipped_steps_total``,
+    ``guard_clipped_steps_total``, ``guard_rollbacks_total`` counters;
+    ``guard_last_finite_loss`` / ``guard_consecutive_skips`` gauges.
+
+    Forensics: on the FIRST skip of a burst, if ``replay_dir`` is set and
+    the caller passed ``params``/``batch``, the faulting step is dumped as
+    a replay bundle (bounded by ``max_bundles``, oldest deleted first).
+    """
+
+    def __init__(self, checkpointer=None, registry=None, *,
+                 max_consecutive_skips: int = 3, ema_alpha: float = 0.1,
+                 spike_factor: float = 10.0, warmup_steps: int = 5,
+                 replay_dir: Optional[str] = None, max_bundles: int = 4):
+        from deeplearning4j_tpu.telemetry.registry import default_registry
+
+        self.checkpointer = checkpointer
+        self.registry = registry if registry is not None else \
+            default_registry()
+        self.max_consecutive_skips = max(1, int(max_consecutive_skips))
+        self.ema_alpha = float(ema_alpha)
+        self.spike_factor = float(spike_factor)
+        self.warmup_steps = max(0, int(warmup_steps))
+        self.replay_dir = replay_dir
+        self.max_bundles = max(1, int(max_bundles))
+        self.skipped_steps = 0
+        self.clipped_steps = 0
+        self.rollbacks = 0
+        self.consecutive_skips = 0
+        self.last_finite_loss: Optional[float] = None
+        self._ema: Optional[float] = None
+        self._n_finite = 0
+        self._divergence: Optional[str] = None
+        self._bundles: List[str] = []
+
+    # -- health --
+    @property
+    def diverged(self) -> bool:
+        return self._divergence is not None
+
+    @property
+    def divergence_reason(self) -> Optional[str]:
+        return self._divergence
+
+    def observe(self, step: int, loss, guard_metrics: Optional[Dict] = None,
+                *, params=None, batch=None, rng_key=None,
+                meta: Optional[Dict] = None) -> str:
+        """Digest one step's outcome; see the class docstring for the
+        verdict semantics."""
+        loss = float(loss)
+        gm = guard_metrics or {}
+        skipped = (float(gm.get("nonfinite", 0.0)) > 0.0
+                   or not math.isfinite(loss))
+        if skipped:
+            self.skipped_steps += 1
+            self.consecutive_skips += 1
+            self.registry.counter("guard_skipped_steps_total").inc()
+            self.registry.gauge("guard_consecutive_skips").set(
+                float(self.consecutive_skips))
+            if self.consecutive_skips == 1:
+                self._dump_bundle(step, loss, params, batch, rng_key, meta)
+            tracer = _trace.get_tracer()
+            if tracer is not None:
+                sp = tracer.current_span()
+                if sp is not None:
+                    sp.add_event("nonfinite", step=int(step))
+            log.warning("guard: non-finite step %d skipped (loss=%r, "
+                        "consecutive=%d)", step, loss,
+                        self.consecutive_skips)
+            if self.consecutive_skips >= self.max_consecutive_skips:
+                self._declare(f"{self.consecutive_skips} consecutive "
+                              f"non-finite steps at step {step}")
+            return "diverged" if self.diverged else "skipped"
+        # finite step
+        self.consecutive_skips = 0
+        self.registry.gauge("guard_consecutive_skips").set(0.0)
+        self.last_finite_loss = loss
+        self.registry.gauge("guard_last_finite_loss").set(loss)
+        verdict = "ok"
+        if float(gm.get("clipped", 0.0)) > 0.0:
+            self.clipped_steps += 1
+            self.registry.counter("guard_clipped_steps_total").inc()
+            verdict = "clipped"
+        if (self._ema is not None and self._n_finite >= self.warmup_steps
+                and self._ema > 0.0
+                and loss > self.spike_factor * self._ema):
+            self._declare(
+                f"loss {loss:.6g} spiked above {self.spike_factor}x the "
+                f"EMA {self._ema:.6g} at step {step}")
+        else:
+            a = self.ema_alpha
+            self._ema = loss if self._ema is None else \
+                a * loss + (1.0 - a) * self._ema
+        self._n_finite += 1
+        return "diverged" if self.diverged else verdict
+
+    def _declare(self, reason: str) -> None:
+        if self._divergence is not None:
+            return
+        self._divergence = reason
+        self.registry.counter("guard_divergence_total").inc()
+        log.error("guard watchdog: divergence — %s", reason)
+        tracer = _trace.get_tracer()
+        if tracer is not None:
+            tracer.dump("divergence", extra={"reason": reason})
+
+    def _dump_bundle(self, step, loss, params, batch, rng_key, meta) -> None:
+        if self.replay_dir is None or (params is None and batch is None):
+            return
+        payload: Dict = {}
+        if params is not None:
+            payload["params"] = params
+        if batch is not None:
+            payload["batch"] = batch
+        bundle_meta = dict(meta or {})
+        bundle_meta["loss"] = repr(loss)
+        if rng_key is not None:
+            bundle_meta["rng_key"] = np.asarray(rng_key).tolist()
+        try:
+            path = dump_replay_bundle(self.replay_dir, step, payload,
+                                      bundle_meta)
+        except Exception:  # forensics must never kill the guarded run
+            log.exception("guard: replay-bundle dump failed for step %d",
+                          step)
+            return
+        self._bundles.append(path)
+        self.registry.counter("guard_replay_bundles_total").inc()
+        while len(self._bundles) > self.max_bundles:
+            stale = self._bundles.pop(0)
+            try:
+                os.remove(stale)
+            except OSError:
+                pass
+        log.warning("guard: replay bundle for faulting step %d -> %s",
+                    step, path)
+
+    @property
+    def bundles(self) -> List[str]:
+        return list(self._bundles)
+
+    # -- checkpoint policy --
+    def note_checkpoint(self, step: int) -> None:
+        """Call after a checkpoint of ``step`` commits: tags it
+        ``last_good`` iff the run is currently healthy (no divergence, not
+        mid-skip-burst) — a snapshot taken while the loss is blowing up
+        must never become the rollback target."""
+        if self.checkpointer is None or self.diverged:
+            return
+        if self.consecutive_skips == 0:
+            self.checkpointer.mark_last_good(int(step))
+
+    def rollback(self, template, shardings=None):
+        """Restore the ``last_good`` checkpoint (falling back to the
+        latest committed step if none was ever tagged) and reset the
+        divergence state so training can resume. Returns
+        ``(state, step, meta)`` — exactly ``Checkpointer.restore``."""
+        if self.checkpointer is None:
+            raise RuntimeError(
+                "watchdog rollback needs a checkpointer (construct with "
+                "DivergenceWatchdog(checkpointer=...))")
+        step = self.checkpointer.last_good_step()
+        state, got, meta = self.checkpointer.restore(
+            template, shardings, step=step)
+        self.rollbacks += 1
+        self.registry.counter("guard_rollbacks_total").inc()
+        log.warning("guard watchdog: rolled back to last_good step %d "
+                    "(divergence: %s)", got, self._divergence)
+        tracer = _trace.get_tracer()
+        if tracer is not None:
+            tracer.dump("rollback", extra={"restored_step": int(got),
+                                           "reason": self._divergence})
+        self._divergence = None
+        self.consecutive_skips = 0
+        self._ema = None
+        self._n_finite = 0
+        return state, got, meta

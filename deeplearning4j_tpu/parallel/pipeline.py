@@ -272,7 +272,7 @@ def make_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
                              mesh: Mesh, axis: str = PIPE_AXIS,
                              lr: float = 0.1,
                              batch_axis: "str | None" = None,
-                             with_metrics: bool = False):
+                             with_metrics: bool = False, guard=None):
     """SGD train step over the pipelined stack.
 
     loss = mean over microbatches of ``loss_fn(y, labels_mb)`` on the
@@ -286,7 +286,20 @@ def make_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
     grad_norm, param_norm, update_ratio, per-microbatch loss vector) and
     returns (new_params, loss, metrics) — same loss/grad graph, so params
     stay bit-identical to the plain step.
+
+    ``guard=True`` (or a ``GuardConfig``) arms the numerical guardrails on
+    the staged update — skip-on-nonfinite + optional global-norm clip
+    (optimize/guardrails.py) — returning (new_params, loss, metrics) where
+    metrics is the guard block (plus the telemetry block when
+    ``with_metrics``); bit-identical to the unguarded step on clean
+    microbatches (pinned in tests/test_guardrails.py).
     """
+    from deeplearning4j_tpu.optimize.guardrails import (
+        GuardConfig,
+        guarded_sgd_update,
+    )
+
+    guard = GuardConfig.coerce(guard)
 
     def loss_of(params, x_mbs, y_mbs):
         outs = pipeline_apply(params, x_mbs, stage_fn, mesh, axis,
@@ -294,7 +307,7 @@ def make_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
         per = jax.vmap(loss_fn)(outs, y_mbs)
         return jnp.mean(per), per
 
-    if not with_metrics:
+    if not with_metrics and guard is None:
         @partial(jax.jit, donate_argnums=(0,))
         def step(params, x_mbs, y_mbs):
             (loss, _), grads = jax.value_and_grad(
@@ -311,12 +324,19 @@ def make_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
     def step(params, x_mbs, y_mbs):
         (loss, per), grads = jax.value_and_grad(
             loss_of, has_aux=True)(params, x_mbs, y_mbs)
-        new_params = jax.tree_util.tree_map(
-            lambda p, g: p - lr * g, params, grads)
-        metrics = {
-            "microbatch_loss": per.reshape(per.shape[0], -1).mean(axis=1),
-            **train_step_metrics(params, grads, lr, loss=loss),
-        }
+        if guard is None:
+            new_params = jax.tree_util.tree_map(
+                lambda p, g: p - lr * g, params, grads)
+            gm = {}
+        else:
+            new_params, gm = guarded_sgd_update(params, grads, loss, lr,
+                                                guard)
+        metrics = dict(gm)
+        if with_metrics:
+            metrics.update({
+                "microbatch_loss": per.reshape(per.shape[0], -1).mean(axis=1),
+                **train_step_metrics(params, grads, lr, loss=loss),
+            })
         return new_params, loss, metrics
 
     return step

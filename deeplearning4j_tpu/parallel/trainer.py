@@ -47,7 +47,7 @@ Array = jax.Array
 
 def _local_grad_step(conf, params, states, iteration, x, y, w, key,
                      sync_grads: bool, ablate_collectives: bool = False,
-                     with_metrics: bool = False):
+                     with_metrics: bool = False, guard=None):
     """One update step over a weighted batch shard.
 
     ``w`` is a per-row weight (0 for padded rows). The loss is the weighted
@@ -102,6 +102,32 @@ def _local_grad_step(conf, params, states, iteration, x, y, w, key,
         # all-padded shard in local mode: freeze params entirely — otherwise
         # apply_updater's L1/L2 decay would still drift them on zero grads
         upd_scale = jnp.where(jnp.sum(w) > 0, 1.0, 0.0).astype(jnp.float32)
+    guard_metrics = {}
+    guard_finite = None
+    if guard is not None:
+        # numerical guardrails (optimize/guardrails.py): finiteness of the
+        # (post-psum, so replica-consistent) score + grad global-norm,
+        # optional clip before the updater sees the grads, and — below —
+        # a skip select carrying params AND updater state unchanged
+        # through a non-finite step. Clean steps stay bit-identical
+        # (exact-1.0 clip scale, exact select pass-through).
+        from deeplearning4j_tpu.optimize.guardrails import (
+            clip_by_global_norm,
+            guard_stats,
+        )
+
+        gn, guard_finite = guard_stats(score, grads)
+        clipped = jnp.float32(0.0)
+        if guard.clip_norm is not None:
+            grads, was_clipped = clip_by_global_norm(grads, gn,
+                                                     guard.clip_norm)
+            clipped = jnp.logical_and(was_clipped,
+                                      guard_finite).astype(jnp.float32)
+        guard_metrics = {
+            "nonfinite": jnp.logical_not(guard_finite).astype(jnp.float32),
+            "clipped": clipped,
+            "guard_grad_norm": gn,
+        }
     new_params = []
     new_states = []
     updates = []
@@ -111,6 +137,19 @@ def _local_grad_step(conf, params, states, iteration, x, y, w, key,
             lambda p, u: p - upd_scale * u, params[i], upd))
         new_states.append(st)
         updates.append(upd)
+    if guard is not None and guard.skip_nonfinite:
+        from deeplearning4j_tpu.optimize.guardrails import guard_select
+
+        # the skip must freeze the WHOLE training state: a NaN grad would
+        # otherwise still poison momentum/adagrad accumulators even with
+        # the params carried
+        new_params = guard_select(guard_finite, tuple(new_params),
+                                  tuple(params))
+        new_states = guard_select(guard_finite, tuple(new_states),
+                                  tuple(states))
+    if not with_metrics and guard is not None:
+        return (tuple(new_params), tuple(new_states), score,
+                guard_metrics)
     if not with_metrics:
         return tuple(new_params), tuple(new_states), score
     # in-graph telemetry block: appended reductions on intermediates the
@@ -124,13 +163,14 @@ def _local_grad_step(conf, params, states, iteration, x, y, w, key,
         "param_norm": global_norm(params),
         "update_ratio": (global_norm(updates) * upd_scale
                          / (global_norm(params) + 1e-12)),
+        **guard_metrics,
     }
     return tuple(new_params), tuple(new_states), score, metrics
 
 
 def make_sync_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
                          ablate_collectives: bool = False,
-                         with_metrics: bool = False):
+                         with_metrics: bool = False, guard=None):
     """Per-step averaging: grads AllReduced every iteration.
 
     step(params, states, iteration, x, y, w, key) — ``w`` is the per-row
@@ -143,14 +183,28 @@ def make_sync_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
     (loss, grad_norm, param_norm, update_ratio) as a 4th output — the
     norms are of the POST-AllReduce gradient, so every host sees the same
     global numbers; feed them to telemetry.TrainTelemetry.
+
+    ``guard=True`` (or a ``GuardConfig``) arms the numerical guardrails
+    (optimize/guardrails.py): a non-finite score or grad norm carries
+    params AND updater state unchanged through the step, optional
+    global-norm clipping runs before the updater, and the guard block
+    (``nonfinite``/``clipped``/``guard_grad_norm``) is appended as the 4th
+    output (merged into the metrics dict when ``with_metrics``). The
+    finiteness test runs on the post-AllReduce score/grads, so every
+    replica takes the same skip decision. Clean steps stay bit-identical
+    (pinned in tests/test_guardrails.py).
     """
+    from deeplearning4j_tpu.optimize.guardrails import GuardConfig
+
+    guard = GuardConfig.coerce(guard)
 
     def step(params, states, iteration, x, y, w, key):
         return _local_grad_step(conf, params, states, iteration, x, y, w, key,
                                 True, ablate_collectives,
-                                with_metrics=with_metrics)
+                                with_metrics=with_metrics, guard=guard)
 
-    out_specs = (P(), P(), P(), P()) if with_metrics else (P(), P(), P())
+    out_specs = ((P(), P(), P(), P()) if (with_metrics or guard is not None)
+                 else (P(), P(), P()))
     sharded = shard_map(
         step,
         mesh=mesh,

@@ -168,6 +168,15 @@ class AsyncCheckpointer:
     def step_dirs(self):
         return self._ck.step_dirs()
 
+    def mark_last_good(self, step: int) -> None:
+        # the tag must never name a step whose (async) save is still in
+        # flight — flush so the marker always points at a committed dir
+        self.flush()
+        self._ck.mark_last_good(step)
+
+    def last_good_step(self):
+        return self._ck.last_good_step()
+
     def gc(self) -> None:
         self._ck.gc()
 

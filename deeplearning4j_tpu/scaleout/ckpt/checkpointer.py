@@ -15,7 +15,9 @@ listener crash is logged and skipped by the chain, never killing the run.
 
 from __future__ import annotations
 
+import json
 import logging
+import os
 import shutil
 import threading
 import time
@@ -67,6 +69,32 @@ class Checkpointer:
         # keep_last mid-restore
         self._pin_lock = threading.Lock()
         self._last_resolved_step: Optional[int] = None
+
+    # ------------------------------------------------------- last_good ----
+    def _last_good_path(self) -> str:
+        return os.path.join(self.root, "LAST_GOOD.json")
+
+    def mark_last_good(self, step: int) -> None:
+        """Tag ``step`` as the divergence watchdog's rollback target
+        (optimize/guardrails.DivergenceWatchdog.note_checkpoint). The tag
+        is a marker file next to the step dirs (atomic tmp+replace), so it
+        survives the process and is visible to every reader of the root;
+        ``gc()`` never collects the tagged step."""
+        os.makedirs(self.root, exist_ok=True)
+        tmp = f"{self._last_good_path()}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump({"step": int(step), "ts": time.time()}, fh)
+        os.replace(tmp, self._last_good_path())
+        self.registry.gauge(f"{self.prefix}_last_good_step").set(float(step))
+
+    def last_good_step(self) -> Optional[int]:
+        """The tagged rollback target, or None when none was ever tagged
+        (rollback then falls back to the latest committed step)."""
+        try:
+            with open(self._last_good_path()) as fh:
+                return int(json.load(fh)["step"])
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
 
     # ------------------------------------------------------------- save ----
     def save(self, step: int, state, meta: Optional[Dict] = None,
@@ -235,15 +263,21 @@ class Checkpointer:
         Never deletes the step a reader most recently resolved via
         ``latest_step()``/``restore()``: a background save pushing that
         step out of the retention window mid-restore (the retention race)
-        would otherwise yank the files out from under the reader."""
+        would otherwise yank the files out from under the reader. Never
+        deletes the step tagged ``last_good`` either (the watchdog's
+        rollback target — retention pressure must not destroy the only
+        known-healthy snapshot; extends the PR 6 retention-race fix)."""
         committed = mf.committed_steps(self.root)
         if not committed:
             return
         newest = committed[-1][0]
         with self._pin_lock:
             pinned = self._last_resolved_step
+        last_good = self.last_good_step()
         for step, step_dir in committed[:-self.keep_last]:
             if pinned is not None and step == pinned:
+                continue
+            if last_good is not None and step == last_good:
                 continue
             shutil.rmtree(step_dir, ignore_errors=True)
         for step, step_dir in mf.uncommitted_dirs(self.root):

@@ -35,6 +35,15 @@ the current version's params + step and is admitted from the current round
 set of at least ``min_workers``, and raises ``ElasticTrainingError`` below
 that.
 
+Numerical faults (ISSUE 8): averaging is exactly how one poisoned worker
+would contaminate every survivor, so the master gates every contribution
+on ``guardrails.tree_all_finite`` BEFORE it can reach ``average_trees`` —
+a NaN/Inf tree quarantines its worker through the bury path (excluded
+from the barrier and from all future averaging; ``workers_quarantined``
+counter, ``nonfinite`` barrier event + flight dump). Worker-side,
+``SyntheticRegressionModel(guard=True)`` runs the guarded SGD update so a
+poisoned batch is skipped in-graph and never reaches a publish at all.
+
 Persistence: the master checkpoints the averaged params through
 ``scaleout.ckpt`` (optionally via ``AsyncCheckpointer`` so snapshots stay
 off the training/aggregation thread) and ``resume()`` restarts from the
@@ -172,13 +181,29 @@ class SyntheticRegressionModel(ElasticModel):
     """Teacher-student MLP regression with a jitted data-parallel mesh
     step — the reference workload for elastic tests and the SparkNet
     sync-period bench. Deterministic end to end: params from a fixed init
-    key, batches from ``fold_in(data_key, worker_seed, step)``."""
+    key, batches from ``fold_in(data_key, worker_seed, step)``.
+
+    Guardrails (ISSUE 8): ``guard=True`` swaps in the guarded SGD update
+    (optimize/guardrails.py — skip-on-nonfinite, optional ``clip_norm``);
+    skips are counted on ``self.skipped_steps``. Fault injection for the
+    elastic NaN matrix: ``nan_at_step`` poisons the batch of that global
+    step index with a NaN (restricted to ``nan_worker_seed`` when set) —
+    a pure function of (worker_seed, step), so ``simulate_elastic`` with
+    the same knobs is still an exact oracle."""
 
     def __init__(self, d_in: int = 8, d_hidden: int = 16, batch: int = 32,
-                 lr: float = 0.05, seed: int = 0, mesh_devices: int = 2):
+                 lr: float = 0.05, seed: int = 0, mesh_devices: int = 2,
+                 guard: bool = False, clip_norm: Optional[float] = None,
+                 nan_at_step: Optional[int] = None,
+                 nan_worker_seed: Optional[int] = None):
         self.d_in, self.d_hidden = int(d_in), int(d_hidden)
         self.batch, self.lr, self.seed = int(batch), float(lr), int(seed)
         self.mesh_devices = int(mesh_devices)
+        self.guard = bool(guard)
+        self.clip_norm = clip_norm
+        self.nan_at_step = nan_at_step
+        self.nan_worker_seed = nan_worker_seed
+        self.skipped_steps = 0
         self._step = None
         self._mesh = None
 
@@ -202,9 +227,22 @@ class SyntheticRegressionModel(ElasticModel):
         k = jax.random.PRNGKey(self.seed + 1000)
         return jax.random.normal(k, (self.d_in, 1))
 
+    @staticmethod
+    def _loss_of(p, x, y):
+        import jax.numpy as jnp
+
+        h = jnp.tanh(x @ p["w1"] + p["b1"])
+        return jnp.mean((h @ p["w2"] - y) ** 2)
+
+    def _guard_config(self):
+        if not self.guard:
+            return None
+        from deeplearning4j_tpu.optimize.guardrails import GuardConfig
+
+        return GuardConfig(clip_norm=self.clip_norm)
+
     def _build(self):
         import jax
-        import jax.numpy as jnp
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
         n = max(1, min(self.mesh_devices, len(jax.devices())))
@@ -213,17 +251,25 @@ class SyntheticRegressionModel(ElasticModel):
         self._batch_sharding = NamedSharding(self._mesh, P("data"))
         self._rep_sharding = NamedSharding(self._mesh, P())
         lr = self.lr
+        loss_of = self._loss_of
+        guard_cfg = self._guard_config()
 
-        def step(params, x, y):
-            def loss_fn(p):
-                h = jnp.tanh(x @ p["w1"] + p["b1"])
-                pred = h @ p["w2"]
-                return jnp.mean((pred - y) ** 2)
+        if guard_cfg is None:
+            def step(params, x, y):
+                loss, grads = jax.value_and_grad(loss_of)(params, x, y)
+                new = jax.tree_util.tree_map(lambda p, g: p - lr * g,
+                                             params, grads)
+                return new, loss
+        else:
+            from deeplearning4j_tpu.optimize.guardrails import (
+                guarded_sgd_update,
+            )
 
-            loss, grads = jax.value_and_grad(loss_fn)(params)
-            new = jax.tree_util.tree_map(lambda p, g: p - lr * g,
-                                         params, grads)
-            return new, loss
+            def step(params, x, y):
+                loss, grads = jax.value_and_grad(loss_of)(params, x, y)
+                new, gm = guarded_sgd_update(params, grads, loss, lr,
+                                             guard_cfg)
+                return new, loss, gm["nonfinite"]
 
         self._step = jax.jit(step, donate_argnums=(0,))
 
@@ -235,7 +281,17 @@ class SyntheticRegressionModel(ElasticModel):
                                int(worker_seed)), int(step_index))
         x = jax.random.normal(k, (self.batch, self.d_in))
         y = x @ self._teacher()
-        return np.asarray(x), np.asarray(y)
+        x = np.asarray(x)
+        if (self.nan_at_step is not None
+                and int(step_index) == int(self.nan_at_step)
+                and (self.nan_worker_seed is None
+                     or int(worker_seed) == int(self.nan_worker_seed))):
+            # deterministic fault injection: poison ONE element of this
+            # step's batch — still a pure function of (worker_seed, step),
+            # so the simulate_elastic oracle reproduces it exactly
+            x = x.copy()
+            x[0, 0] = np.nan
+        return x, np.asarray(y)
 
     def eval_loss(self, params, n_batches: int = 8,
                   eval_seed: int = 10_007) -> float:
@@ -261,13 +317,22 @@ class SyntheticRegressionModel(ElasticModel):
         params = jax.device_put(
             jax.tree_util.tree_map(np.asarray, params), self._rep_sharding)
         loss = None
+        nonfinite_flags = []  # device scalars; ONE fetch after the loop
         for i in range(int(n_steps)):
             x, y = self._batch_for(worker_seed, start_step + i)
-            params, loss = self._step(
+            out = self._step(
                 params,
                 jax.device_put(x, self._batch_sharding),
                 jax.device_put(y, self._batch_sharding))
+            if self.guard:
+                params, loss, nf = out
+                nonfinite_flags.append(nf)
+            else:
+                params, loss = out
         host = jax.tree_util.tree_map(np.asarray, jax.device_get(params))
+        if nonfinite_flags:
+            self.skipped_steps += int(sum(
+                float(v) for v in jax.device_get(nonfinite_flags)))
         return host, (float(loss) if loss is not None else float("nan"))
 
 
@@ -275,6 +340,29 @@ def synthetic_regression_model(**kwargs) -> SyntheticRegressionModel:
     """CLI factory (``--model deeplearning4j_tpu.scaleout.elastic:
     synthetic_regression_model``)."""
     return SyntheticRegressionModel(**kwargs)
+
+
+def synthetic_replay(**kwargs):
+    """``tools/step_replay.py`` factory for SyntheticRegressionModel replay
+    bundles (``--factory deeplearning4j_tpu.scaleout.elastic:
+    synthetic_replay``): re-executes the faulting step's loss + grad from
+    a payload of ``{"params": ..., "batch": {"x", "y"}}`` using the exact
+    training loss — deterministic, so the non-finite result reproduces."""
+    import jax
+    import jax.numpy as jnp
+
+    model = SyntheticRegressionModel(**kwargs)
+
+    def run(payload: Dict) -> Dict:
+        from deeplearning4j_tpu.telemetry.metrics import global_norm
+
+        p = jax.tree_util.tree_map(jnp.asarray, payload["params"])
+        x = jnp.asarray(payload["batch"]["x"])
+        y = jnp.asarray(payload["batch"]["y"])
+        loss, grads = jax.value_and_grad(model._loss_of)(p, x, y)
+        return {"loss": float(loss), "grad_norm": float(global_norm(grads))}
+
+    return run
 
 
 # ---------------------------------------------------------- blob layout ----
@@ -556,7 +644,8 @@ class ElasticMaster:
                  register_timeout_s: float = 60.0,
                  round_timeout_s: float = 120.0, tick_s: float = 0.01,
                  checkpointer=None, checkpoint_every: int = 0,
-                 registry=None, trace_dir: Optional[str] = None):
+                 registry=None, trace_dir: Optional[str] = None,
+                 quarantine_nonfinite: bool = True):
         from deeplearning4j_tpu.telemetry.registry import default_registry
 
         # tracing: adopt the process tracer if one is configured; a
@@ -588,6 +677,12 @@ class ElasticMaster:
         self._template = self.model.init_params()
         self._hb_seen: Dict[str, tuple] = {}
         self._admit: Dict[str, int] = {}
+        # numerical quarantine (ISSUE 8): a contribution with any
+        # non-finite leaf is excluded from the average and its worker is
+        # buried (removed from the round barrier) — sticky for the run, so
+        # averaging can NEVER ingest a poisoned delta
+        self.quarantine_nonfinite = bool(quarantine_nonfinite)
+        self._quarantined: set = set()
         self._publish_version(self.version, self._params)
 
     # -- plumbing --
@@ -655,7 +750,9 @@ class ElasticMaster:
     def _contributions(self, rnd: int) -> Dict[str, tuple]:
         """(tree, n_steps) per worker that has a committed contribution
         blob for ``rnd`` — includes workers that died after publishing
-        (their synced work is kept; only unsynced deltas are lost)."""
+        (their synced work is kept; only unsynced deltas are lost), but
+        never a quarantined worker's (its numerical trust is gone for the
+        run; see ``_quarantine``)."""
         out: Dict[str, tuple] = {}
         signals = self.tracker.counters_snapshot(f"contrib.{rnd}.")
         template = self._template
@@ -663,12 +760,45 @@ class ElasticMaster:
             if val <= 0:
                 continue
             wid = key[len(f"contrib.{rnd}."):]
+            if wid in self._quarantined:
+                continue
             data = self.blob.try_get(_contrib_key(rnd, wid))
             if data is None:
                 continue  # signal raced the (atomic) blob publish; re-poll
             tree, meta = tree_from_bytes(data, template)
             out[wid] = (tree, float(meta.get("n_steps", self.sync_every)))
         return out
+
+    def _quarantine(self, wid: str, rnd: int, barrier_sp=None) -> None:
+        """The bury path for NUMERICAL faults: a worker whose round-``rnd``
+        contribution carries NaN/Inf is removed from membership (so the
+        barrier stops waiting for it) and excluded from every future
+        round's averaging — one poisoned delta must never contaminate the
+        survivors. Sticky for the run: replace the worker process to
+        rejoin. Recorded as the ``nonfinite`` barrier event + a flight
+        dump, the forensic trail the fault-matrix test pins."""
+        from deeplearning4j_tpu.optimize.guardrails import nonfinite_report
+
+        self._quarantined.add(wid)
+        self.tracker.remove_worker(wid)
+        self._hb_seen.pop(wid, None)
+        self.tracker.increment("workers_quarantined")
+        self.registry.counter("elastic_workers_quarantined_total").inc()
+        log.error("elastic worker %s published a NON-FINITE contribution "
+                  "for round %s: quarantined (excluded from averaging and "
+                  "the round barrier for the rest of the run)", wid, rnd)
+        if barrier_sp is not None:
+            barrier_sp.add_event("nonfinite", worker=wid, round=rnd)
+        if self.tracer is not None:
+            data = self.blob.try_get(_contrib_key(rnd, wid))
+            report = []
+            if data is not None:
+                tree, _meta = tree_from_bytes(data, self._template)
+                report = [e for e in nonfinite_report(tree)
+                          if e.get("nonfinite")]
+            self.tracer.dump("nonfinite",
+                             extra={"worker": wid, "round": int(rnd),
+                                    "poisoned_leaves": report})
 
     # -- lifecycle --
     def wait_for_workers(self, n: Optional[int] = None) -> None:
@@ -768,6 +898,21 @@ class ElasticMaster:
                         f"{self.min_workers} at round {rnd} — halting "
                         "(raise min_workers tolerance or add workers)")
                 contribs = self._contributions(rnd)
+                if self.quarantine_nonfinite:
+                    from deeplearning4j_tpu.optimize.guardrails import (
+                        tree_all_finite,
+                    )
+
+                    for w in sorted(contribs):
+                        if not tree_all_finite(contribs[w][0]):
+                            contribs.pop(w)
+                            self._quarantine(w, rnd, barrier_sp)
+                    live = self._live_workers()  # quarantine shrank the set
+                    if len(live) < self.min_workers:
+                        raise ElasticTrainingError(
+                            f"survivor set {live} below min_workers="
+                            f"{self.min_workers} after quarantine at round "
+                            f"{rnd} — halting")
                 if barrier_sp is not None:
                     for w in sorted(contribs):
                         if w not in seen:

@@ -91,13 +91,45 @@ def read_step_log(path: str) -> List[Dict]:
     return out
 
 
+_NONFINITE_REPRS = ("nan", "inf", "-inf")
+_NON_METRIC_KEYS = ("ts", "step")
+
+
+def _is_nonfinite_value(v) -> bool:
+    """A value the writer preserved as a non-finite marker: the repr string
+    ``_jsonable`` emits ('nan'/'inf'/'-inf'), or a raw non-finite float
+    (records built in-process, never serialized)."""
+    if isinstance(v, str):
+        return v.strip().lower() in _NONFINITE_REPRS
+    return isinstance(v, float) and not math.isfinite(v)
+
+
+def nonfinite_counts(records: List[Dict]) -> Dict[str, int]:
+    """Per-metric count of non-finite values across the log — the
+    numerical-fault signal the report must SHOUT about, not silently
+    repr (step_log preserves them; this surfaces them)."""
+    counts: Dict[str, int] = {}
+    for r in records:
+        for k, v in r.items():
+            if k in _NON_METRIC_KEYS:
+                continue
+            vals = v if isinstance(v, list) else [v]
+            n = sum(1 for vi in vals if _is_nonfinite_value(vi))
+            if n:
+                counts[k] = counts.get(k, 0) + n
+    return counts
+
+
 def summarize_step_log(records: List[Dict]) -> Dict:
     """Aggregate a step log into the throughput/grad-norm summary the
     bench detail and tools/telemetry_report.py print.
 
     Returns {steps, wall_ms: {mean, p50, p95}, tokens_per_sec_mean,
     loss: {first, last}, grad_norm: {first, last}, router_load_mean}.
-    Absent fields are simply omitted.
+    Absent fields are simply omitted. When any metric carried NaN/Inf
+    values a ``nonfinite`` {metric: count} map is included (plus
+    ``skipped_steps``/``clipped_steps`` totals when the guardrails
+    counters are in the log) — downstream reports flag these loudly.
     """
     if not records:
         return {"steps": 0}
@@ -106,6 +138,15 @@ def summarize_step_log(records: List[Dict]) -> Dict:
     def series(key):
         return [r[key] for r in records
                 if isinstance(r.get(key), (int, float))]
+
+    bad = nonfinite_counts(records)
+    if bad:
+        out["nonfinite"] = bad
+    for key, name in (("nonfinite", "skipped_steps"),
+                      ("clipped", "clipped_steps")):
+        vals = series(key)
+        if vals:
+            out[name] = int(sum(v > 0 for v in vals))
 
     walls = series("wall_ms")
     if walls:

@@ -483,3 +483,47 @@ def test_master_crash_mid_manifest_merge_resumes_prior_step(tmp_path):
     ck.save(5, {"params": p}, mesh=mesh)
     assert not os.path.isdir(os.path.join(str(tmp_path), step_dir_name(4)))
     assert ck.latest_step() == 5
+
+
+# ------------------------------------------------- last_good retention ----
+
+def test_gc_never_collects_last_good_step(tmp_path):
+    """ISSUE 8 satellite: the step the divergence watchdog tagged
+    ``last_good`` survives ANY amount of retention pressure (extends the
+    PR 6 retention-race pin) — and a rollback restore from it is
+    byte-clean even after keep_last would have collected it, including
+    from a FRESH Checkpointer (the tag is a marker file, not memory)."""
+    ck = Checkpointer(str(tmp_path), keep_last=2,
+                      registry=MetricsRegistry())
+    step = make_single_device_train_step(H, attn_impl="dense")
+    p = _params()
+    snapshots = {}
+    for i in range(1, 7):
+        tk, tg = _step_data(i)
+        p, loss = step(p, tk, tg)
+        jax.block_until_ready(loss)
+        ck.save(i, {"params": p})
+        snapshots[i] = jax.tree_util.tree_map(np.asarray,
+                                              jax.device_get(p))
+        if i == 2:
+            ck.mark_last_good(2)  # the watchdog's note_checkpoint path
+    kept = [s for s, _ in ck.step_dirs()]
+    # keep_last=2 keeps {5, 6}; step 2 SURVIVES because it is last_good
+    assert kept == [2, 5, 6], kept
+    assert ck.last_good_step() == 2
+    # rollback-grade restore of the pinned step, via a FRESH reader
+    ck2 = Checkpointer(str(tmp_path), keep_last=2,
+                       registry=MetricsRegistry())
+    assert ck2.last_good_step() == 2
+    state, got, _meta = ck2.restore({"params": _params()},
+                                    step=ck2.last_good_step())
+    assert got == 2
+    _assert_close(state["params"], snapshots[2], "last_good restore",
+                  atol=0.0)
+    # moving the tag releases the old pin on the next sweep (a fresh
+    # reader: ck2's restore also reader-pinned step 2 — the PR 6 race pin)
+    ck2.mark_last_good(6)
+    ck3 = Checkpointer(str(tmp_path), keep_last=2,
+                       registry=MetricsRegistry())
+    ck3.gc()
+    assert [s for s, _ in ck3.step_dirs()] == [5, 6]

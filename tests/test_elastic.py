@@ -309,6 +309,129 @@ def test_elastic_staleness_runs_ahead_of_commits(tmp_path):
     assert not t.is_alive(), "stale worker failed to finish"
 
 
+# ------------------------------------------------- numerical faults (#8) ----
+
+def test_elastic_guarded_worker_skips_nan_batch(tmp_path):
+    """ISSUE 8 fault matrix, worker side: a guarded worker hit by a NaN
+    batch SKIPS the step in-graph (params carried, publish stays finite)
+    and the run commits every round with full parity against the
+    simulate_elastic oracle running the identical guarded model — the
+    poison never reaches the averaging at all."""
+    from deeplearning4j_tpu.scaleout.elastic import SyntheticRegressionModel
+
+    def model():
+        # NaN batch at global step 3 (= round 1 under sync_every=2) for
+        # worker_seed=2 only — deterministic, so the oracle reproduces it
+        return SyntheticRegressionModel(
+            d_in=4, d_hidden=8, batch=8, lr=0.05, mesh_devices=1,
+            guard=True, nan_at_step=3, nan_worker_seed=2)
+
+    blob = f"file://{tmp_path / 'blob'}"
+    master = ElasticMaster(model(), blob, sync_every=2, min_workers=2,
+                           worker_timeout_s=30.0, register_timeout_s=60,
+                           round_timeout_s=90)
+    workers = [
+        ElasticWorker(master.address, blob, model(), worker_id=f"w{s}",
+                      worker_seed=s, sync_every=2, round_timeout_s=90)
+        for s in (1, 2)
+    ]
+    threads = [threading.Thread(target=w.run, daemon=True) for w in workers]
+    for t in threads:
+        t.start()
+    try:
+        master.wait_for_workers(2)
+        final = master.train(rounds=3)
+    finally:
+        master.shutdown()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive()
+    # the guard fired exactly once, on the poisoned worker's model
+    assert workers[1].model.skipped_steps == 1
+    assert workers[0].model.skipped_steps == 0
+    # nobody was quarantined: the worker-side skip kept its publish finite
+    assert master.tracker.count("workers_quarantined") == 0
+    assert int(master.tracker.count(VERSION_KEY)) == 3
+    ref, _ = simulate_elastic(model(), [1, 2], sync_every=2, rounds=3)
+    _assert_tree_close(final, ref, 1e-6, "guarded-skip parity")
+
+
+def test_elastic_quarantine_poisoned_contribution(tmp_path):
+    """ISSUE 8 fault matrix, master side: an UNGUARDED worker publishes a
+    NaN-poisoned contribution — the master quarantines it through the bury
+    path BEFORE averaging (the survivors' params match the oracle that
+    never saw the poison, 1e-6), the round barrier stops waiting for it,
+    and the forensic trail lands end to end: ``workers_quarantined``
+    counter, the barrier span's ``nonfinite`` event naming the worker, and
+    a flight-recorder dump with the poisoned-leaf report."""
+    from deeplearning4j_tpu.scaleout.elastic import SyntheticRegressionModel
+
+    def model(**kw):
+        d = dict(d_in=4, d_hidden=8, batch=8, lr=0.05, mesh_devices=1)
+        d.update(kw)
+        return SyntheticRegressionModel(**d)
+
+    blob = f"file://{tmp_path / 'blob'}"
+    trace_dir = str(tmp_path / "trace")
+    # a long checkpoint interval keeps the round-commit write-ahead dumps
+    # from overwriting the quarantine's "nonfinite" dump on a slow box
+    # (explicit dump() calls are never rate-limited)
+    prev = trace_mod.set_tracer(trace_mod.Tracer(
+        "master", trace_dir=trace_dir, registry=MetricsRegistry(),
+        min_checkpoint_interval_s=3600.0))
+    try:
+        master = ElasticMaster(model(), blob, sync_every=2, min_workers=1,
+                               worker_timeout_s=30.0, register_timeout_s=60,
+                               round_timeout_s=90)
+        clean = ElasticWorker(master.address, blob, model(),
+                              worker_id="clean", worker_seed=1,
+                              sync_every=2, round_timeout_s=90)
+        # unguarded + NaN at global step 2 (round 1): trains THROUGH the
+        # NaN, so its round-1 publish carries non-finite params
+        poison = ElasticWorker(master.address, blob,
+                               model(nan_at_step=2, nan_worker_seed=2),
+                               worker_id="poison", worker_seed=2,
+                               sync_every=2, round_timeout_s=90)
+        threads = [threading.Thread(target=w.run, daemon=True)
+                   for w in (clean, poison)]
+        for t in threads:
+            t.start()
+        try:
+            master.wait_for_workers(2)
+            final = master.train(rounds=3)
+        finally:
+            master.shutdown()
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        trace_mod.set_tracer(prev)
+    assert master.tracker.count("workers_quarantined") == 1
+    assert "poison" in master._quarantined
+    assert "poison" not in master.tracker.workers()
+    assert int(master.tracker.count(VERSION_KEY)) == 3
+    # averaging never ingested the poisoned delta: round 0 both, round 1+
+    # survivor only (the quarantine is sticky for the run)
+    ref, _ = simulate_elastic(model(), [1, 2], sync_every=2, rounds=3,
+                              schedule={0: [0, 1], 1: [0], 2: [0]})
+    _assert_tree_close(final, ref, 1e-6, "quarantine survivor parity")
+    from deeplearning4j_tpu.optimize.guardrails import tree_all_finite
+
+    assert tree_all_finite(final)
+    # forensics: the barrier span carries the nonfinite event...
+    spans = load_trace_dir(trace_dir)
+    events = [ev for sp in spans.values()
+              if sp["name"] == "elastic.barrier"
+              for ev in sp.get("events", [])
+              if ev.get("name") == "nonfinite"]
+    assert any(ev.get("worker") == "poison" for ev in events), events
+    # ...and the flight dump names the worker + the poisoned leaves
+    dump = json.load(open(os.path.join(trace_dir,
+                                       "flightrec_master.json")))
+    assert dump["reason"] == "nonfinite"
+    assert dump["extra"]["worker"] == "poison"
+    assert dump["extra"]["poisoned_leaves"], dump["extra"]
+
+
 # ----------------------------------------------------- min_workers halt ----
 
 def test_elastic_min_workers_halts_below_quorum(tmp_path):

@@ -1,0 +1,540 @@
+"""ISSUE 8 numerical-fault guardrails: guarded steps are bit-identical to
+unguarded ones on clean batches (every composed path, 0-compile retrace
+budget), a NaN batch/param is skipped with params carried unchanged, the
+divergence watchdog rolls back to the ``last_good`` checkpoint, and the
+replay-bundle → ``tools/step_replay.py`` forensic chain reproduces the
+faulting step deterministically."""
+
+import contextlib
+import json
+import math
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.models.transformer_lm import (
+    init_lm_params,
+    make_composed_train_step,
+    make_single_device_train_step,
+    shard_lm_batch,
+    shard_lm_params,
+)
+from deeplearning4j_tpu.optimize.guardrails import (
+    DivergenceWatchdog,
+    GuardConfig,
+    dump_replay_bundle,
+    guarded_sgd_update,
+    load_replay_bundle,
+    nonfinite_report,
+    tree_all_finite,
+)
+from deeplearning4j_tpu.telemetry.registry import MetricsRegistry
+from deeplearning4j_tpu.utils.retrace_guard import retrace_guard
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+V, D, H, E, DFF = 32, 16, 2, 4, 32
+B, T = 4, 16
+
+
+def _bits_equal(a, b):
+    return np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+
+def _tree_bits_equal(ta, tb):
+    la = jax.tree_util.tree_leaves(jax.device_get(ta))
+    lb = jax.tree_util.tree_leaves(jax.device_get(tb))
+    assert len(la) == len(lb)
+    return all(_bits_equal(a, b) for a, b in zip(la, lb))
+
+
+def _params(n_layers=2):
+    return init_lm_params(jax.random.PRNGKey(0), V, D, H, E, DFF,
+                          n_layers=n_layers)
+
+
+def _data(seed=1):
+    toks = jax.random.randint(jax.random.PRNGKey(seed), (B, T + 1), 0, V)
+    return toks[:, :-1], toks[:, 1:]
+
+
+def _poison(params, leaf="embed"):
+    host = jax.device_get(params)
+    arr = np.asarray(host[leaf]).copy()
+    arr.flat[0] = np.nan
+    host[leaf] = arr
+    return jax.tree_util.tree_map(jnp.asarray, host)
+
+
+# ------------------------------------------------- clean-batch bit parity ----
+
+class TestCleanBatchBitParity:
+    """The acceptance pin: guard=True must be invisible on clean batches —
+    loss AND params bit-identical to the unguarded step, across every
+    composed path, with a 0-compile steady-state retrace budget."""
+
+    def _run(self, plain, guarded, p0, p1, args, steps=3):
+        for i in range(steps):
+            guard_ctx = (contextlib.nullcontext() if i == 0 else
+                         retrace_guard(0, label=f"guarded step {i}"))
+            with guard_ctx:
+                p0, l0 = plain(p0, *args)
+                jax.block_until_ready(l0)
+                p1, l1, gm = guarded(p1, *args)
+                jax.block_until_ready(l1)
+            assert _bits_equal(l0, l1), i
+        assert _tree_bits_equal(p0, p1)
+        gm = jax.device_get(gm)
+        assert float(gm["nonfinite"]) == 0.0
+        assert float(gm["clipped"]) == 0.0
+        assert float(gm["guard_grad_norm"]) > 0
+
+    def test_single_device(self):
+        params = _params()
+        tk, tg = _data()
+        plain = make_single_device_train_step(H, attn_impl="dense")
+        guarded = make_single_device_train_step(H, attn_impl="dense",
+                                                guard=True)
+        self._run(plain, guarded, params, params, (tk, tg))
+
+    def test_single_device_with_generous_clip(self):
+        """A clip threshold far above the actual grad norm yields an
+        exactly-1.0 scale — still bit-identical."""
+        params = _params()
+        tk, tg = _data()
+        plain = make_single_device_train_step(H, attn_impl="dense")
+        guarded = make_single_device_train_step(
+            H, attn_impl="dense", guard=GuardConfig(clip_norm=1e6))
+        self._run(plain, guarded, params, params, (tk, tg))
+
+    def test_dp_ep(self):
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                    ("data", "expert"))
+        params = shard_lm_params(_params(), mesh)
+        tk, tg = shard_lm_batch(*_data(), mesh)
+        cap = (B // 2) * T
+        plain = make_composed_train_step(mesh, H, cap)
+        guarded = make_composed_train_step(mesh, H, cap, guard=True)
+        self._run(plain, guarded, params, params, (tk, tg))
+
+    def test_dp_sp_ep(self):
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                    ("data", "sp", "expert"))
+        params = shard_lm_params(_params(), mesh)
+        tk, tg = shard_lm_batch(*_data(), mesh)
+        cap = (B // 2) * (T // 2)
+        plain = make_composed_train_step(mesh, H, cap)
+        guarded = make_composed_train_step(mesh, H, cap, guard=True)
+        self._run(plain, guarded, params, params, (tk, tg))
+
+    def test_dp_pp(self):
+        from deeplearning4j_tpu.models.transformer_lm import make_pp_stages
+        from deeplearning4j_tpu.parallel.pipeline import (
+            make_pipeline_train_step,
+            shard_stage_params,
+            stack_stage_params,
+        )
+
+        params = _params(n_layers=2)
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                    ("data", "pipe"))
+        per_stage, stage_fn = make_pp_stages(params, H, n_stages=2,
+                                             attn_impl="dense")
+        stacked = shard_stage_params(stack_stage_params(per_stage), mesh,
+                                     "pipe")
+        n_micro, mb = 4, 2
+        toks = jax.random.randint(jax.random.PRNGKey(3),
+                                  (n_micro, mb, T + 1), 0, V)
+        tk, tg = toks[..., :-1], toks[..., 1:]
+
+        def pp_loss(y, tgt_mb):
+            logits = y @ params["dec_w"] + params["dec_b"]
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return jnp.mean(
+                -jnp.take_along_axis(logp, tgt_mb[..., None], -1)[..., 0])
+
+        def copy(t):
+            return jax.tree_util.tree_map(jnp.array, t)
+
+        plain = make_pipeline_train_step(stage_fn, pp_loss, mesh, "pipe",
+                                         batch_axis="data")
+        guarded = make_pipeline_train_step(stage_fn, pp_loss, mesh, "pipe",
+                                           batch_axis="data", guard=True)
+        emb = params["embed"][tk]
+        p0, l0 = plain(copy(stacked), emb, tg)
+        p1, l1, gm = guarded(copy(stacked), emb, tg)
+        assert _bits_equal(l0, l1)
+        assert _tree_bits_equal(p0, p1)
+        assert float(jax.device_get(gm)["nonfinite"]) == 0.0
+
+    def test_trainer_sync_step(self):
+        from deeplearning4j_tpu.nn import functional as F
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
+        from deeplearning4j_tpu.parallel.trainer import make_sync_train_step
+
+        conf = (NeuralNetConfiguration.Builder()
+                .n_in(4).n_out(8).activation_function("tanh").lr(0.1)
+                .num_iterations(1).seed(0).list(2)
+                .override(1, layer_type="OUTPUT", n_in=8, n_out=3,
+                          activation_function="softmax",
+                          loss_function="MCXENT")
+                .pretrain(False).backward(True).build())
+        mesh = data_parallel_mesh(8)
+        params = F.init_params(conf, jax.random.PRNGKey(0))
+        states = F.init_train_state(conf, params)
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.rand(16, 4).astype(np.float32))
+        y = jnp.asarray(np.eye(3, dtype=np.float32)[rng.randint(0, 3, 16)])
+        w = jnp.ones((16,), jnp.float32)
+        key = jax.random.PRNGKey(7)
+
+        def copy(t):
+            return jax.tree_util.tree_map(jnp.array, t)
+
+        plain = make_sync_train_step(conf, mesh)
+        guarded = make_sync_train_step(conf, mesh, guard=True)
+        p0, s0, sc0 = plain(copy(params), copy(states), jnp.asarray(0),
+                            x, y, w, key)
+        p1, s1, sc1, gm = guarded(copy(params), copy(states), jnp.asarray(0),
+                                  x, y, w, key)
+        assert _bits_equal(sc0, sc1)
+        assert _tree_bits_equal(p0, p1)
+        assert _tree_bits_equal(s0, s1)
+        gm = jax.device_get(gm)
+        assert float(gm["nonfinite"]) == 0.0
+        # metrics-threaded twin merges the guard block into the dict
+        both = make_sync_train_step(conf, mesh, with_metrics=True,
+                                    guard=True)
+        p2, s2, sc2, metrics = both(copy(params), copy(states),
+                                    jnp.asarray(0), x, y, w, key)
+        assert _bits_equal(sc0, sc2)
+        assert _tree_bits_equal(p0, p2)
+        m = jax.device_get(metrics)
+        for k in ("loss", "grad_norm", "nonfinite", "clipped",
+                  "guard_grad_norm"):
+            assert k in m
+
+
+# --------------------------------------------------------- skip semantics ----
+
+class TestSkipOnNonfinite:
+    def test_poisoned_lm_params_skip(self):
+        """A NaN anywhere in the params poisons loss + grads; the guarded
+        step carries the incoming params bitwise (skipped_steps==1 via
+        the guard flag) instead of spraying NaN into every leaf."""
+        poisoned = _poison(_params())
+        tk, tg = _data()
+        guarded = make_single_device_train_step(H, attn_impl="dense",
+                                                guard=True)
+        p2, loss, gm = guarded(poisoned, tk, tg)
+        assert not math.isfinite(float(loss))
+        assert float(jax.device_get(gm)["nonfinite"]) == 1.0
+        assert _tree_bits_equal(p2, poisoned)
+        # the UNGUARDED twin really would have poisoned everything —
+        # the guard is load-bearing, not vacuous
+        plain = make_single_device_train_step(H, attn_impl="dense")
+        p3, _ = plain(_poison(_params()), tk, tg)
+        assert not tree_all_finite(p3)
+
+    def test_poisoned_batch_trainer_sync_step(self):
+        """A NaN in the float features (the realistic corrupt-input case)
+        freezes params AND updater state through the step."""
+        from deeplearning4j_tpu.nn import functional as F
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
+        from deeplearning4j_tpu.parallel.trainer import make_sync_train_step
+
+        conf = (NeuralNetConfiguration.Builder()
+                .n_in(4).n_out(8).activation_function("tanh").lr(0.1)
+                .num_iterations(1).seed(0).list(2)
+                .override(1, layer_type="OUTPUT", n_in=8, n_out=3,
+                          activation_function="softmax",
+                          loss_function="MCXENT")
+                .pretrain(False).backward(True).build())
+        mesh = data_parallel_mesh(8)
+        params = F.init_params(conf, jax.random.PRNGKey(0))
+        states = F.init_train_state(conf, params)
+        rng = np.random.RandomState(0)
+        x = rng.rand(16, 4).astype(np.float32)
+        x[3, 1] = np.nan
+        y = jnp.asarray(np.eye(3, dtype=np.float32)[rng.randint(0, 3, 16)])
+        w = jnp.ones((16,), jnp.float32)
+
+        def copy(t):
+            return jax.tree_util.tree_map(jnp.array, t)
+
+        guarded = make_sync_train_step(conf, mesh, guard=True)
+        p1, s1, score, gm = guarded(copy(params), copy(states),
+                                    jnp.asarray(0), jnp.asarray(x), y, w,
+                                    jax.random.PRNGKey(7))
+        assert float(jax.device_get(gm)["nonfinite"]) == 1.0
+        assert _tree_bits_equal(p1, params)
+        assert _tree_bits_equal(s1, states)
+
+    def test_clip_engages_above_threshold(self):
+        """clip_norm below the actual grad norm scales the update (params
+        move LESS than unclipped) and sets the clipped flag; the loss is
+        untouched (clipping is post-grad)."""
+        params = _params()
+        tk, tg = _data()
+        ref = make_single_device_train_step(H, attn_impl="dense",
+                                            guard=True)
+        _, _, gm = ref(params, tk, tg)
+        gn = float(jax.device_get(gm)["guard_grad_norm"])
+        clipping = make_single_device_train_step(
+            H, attn_impl="dense", guard=GuardConfig(clip_norm=gn / 2))
+        p1, loss, gm1 = clipping(params, tk, tg)
+        gm1 = jax.device_get(gm1)
+        assert float(gm1["clipped"]) == 1.0
+        assert float(gm1["nonfinite"]) == 0.0
+        # the clipped update is exactly half the unguarded one
+        plain = make_single_device_train_step(H, attn_impl="dense")
+        p0, loss0 = plain(params, tk, tg)
+        assert _bits_equal(loss, loss0)  # loss precedes the clip
+        d_plain = jax.tree_util.tree_map(lambda a, b: np.asarray(a - b),
+                                         jax.device_get(p0),
+                                         jax.device_get(params))
+        d_clip = jax.tree_util.tree_map(lambda a, b: np.asarray(a - b),
+                                        jax.device_get(p1),
+                                        jax.device_get(params))
+        for a, b in zip(jax.tree_util.tree_leaves(d_plain),
+                        jax.tree_util.tree_leaves(d_clip)):
+            np.testing.assert_allclose(b, a * 0.5, rtol=1e-5, atol=1e-7)
+
+    def test_guarded_sgd_update_direct(self):
+        params = {"w": jnp.ones((3,))}
+        grads = {"w": jnp.full((3,), jnp.inf)}
+        new, gm = jax.jit(guarded_sgd_update, static_argnums=(3, 4))(
+            params, grads, jnp.float32(1.0), 0.1, GuardConfig())
+        assert float(gm["nonfinite"]) == 1.0
+        assert _tree_bits_equal(new, params)
+
+    def test_coerce(self):
+        assert GuardConfig.coerce(None) is None
+        assert GuardConfig.coerce(False) is None
+        assert GuardConfig.coerce(True) == GuardConfig()
+        cfg = GuardConfig(clip_norm=2.0)
+        assert GuardConfig.coerce(cfg) is cfg
+        with pytest.raises(TypeError, match="guard="):
+            GuardConfig.coerce("yes")
+
+
+# --------------------------------------------------------------- watchdog ----
+
+class TestWatchdog:
+    def test_consecutive_skips_declare_divergence(self):
+        reg = MetricsRegistry()
+        wd = DivergenceWatchdog(registry=reg, max_consecutive_skips=3)
+        assert wd.observe(0, 1.0) == "ok"
+        assert wd.observe(1, float("nan"), {"nonfinite": 1.0}) == "skipped"
+        assert wd.observe(2, float("nan"), {"nonfinite": 1.0}) == "skipped"
+        assert wd.observe(3, float("nan"), {"nonfinite": 1.0}) == "diverged"
+        assert wd.diverged and "consecutive" in wd.divergence_reason
+        assert reg.counter("guard_skipped_steps_total").value == 3
+        assert reg.counter("guard_divergence_total").value == 1
+
+    def test_finite_step_resets_the_burst(self):
+        wd = DivergenceWatchdog(registry=MetricsRegistry(),
+                                max_consecutive_skips=2)
+        wd.observe(0, float("nan"), {"nonfinite": 1.0})
+        assert wd.observe(1, 1.0) == "ok"
+        assert wd.observe(2, float("nan"), {"nonfinite": 1.0}) == "skipped"
+        assert not wd.diverged
+
+    def test_ema_spike_declares_divergence(self):
+        reg = MetricsRegistry()
+        wd = DivergenceWatchdog(registry=reg, spike_factor=5.0,
+                                warmup_steps=4)
+        for i in range(4):
+            assert wd.observe(i, 1.0 + 0.01 * i) == "ok"
+        # 3x the EMA is loud but tolerated...
+        assert wd.observe(4, 3.0) == "ok"
+        # ...5x+ is divergence (EMA moved a little from the 3.0 reading)
+        assert wd.observe(5, 50.0) == "diverged"
+        assert "spiked" in wd.divergence_reason
+        assert reg.gauge("guard_last_finite_loss").value == 50.0
+
+    def test_clipped_counter_and_registry(self):
+        reg = MetricsRegistry()
+        wd = DivergenceWatchdog(registry=reg)
+        assert wd.observe(0, 1.0, {"clipped": 1.0}) == "clipped"
+        assert wd.observe(1, 1.0, {"clipped": 0.0}) == "ok"
+        assert reg.counter("guard_clipped_steps_total").value == 1
+        assert wd.clipped_steps == 1
+
+    def test_note_checkpoint_tags_only_while_healthy(self, tmp_path):
+        from deeplearning4j_tpu.scaleout.ckpt import Checkpointer
+
+        ck = Checkpointer(str(tmp_path), registry=MetricsRegistry())
+        wd = DivergenceWatchdog(checkpointer=ck,
+                                registry=MetricsRegistry(),
+                                max_consecutive_skips=5)
+        wd.observe(0, 1.0)
+        wd.note_checkpoint(1)
+        assert ck.last_good_step() == 1
+        wd.observe(1, float("nan"), {"nonfinite": 1.0})
+        wd.note_checkpoint(2)  # mid-burst: must NOT move the tag
+        assert ck.last_good_step() == 1
+
+    def test_rollback_restores_last_good_with_resume_parity(self, tmp_path):
+        """The acceptance rollback: healthy steps checkpointed, step 2
+        tagged last_good, params poisoned, K skips → diverged, rollback
+        restores the step-2 state exactly (kill/resume-grade: the restored
+        tree matches the saved one bitwise, and training continues from it
+        identically to an uninterrupted twin)."""
+        from deeplearning4j_tpu.scaleout.ckpt import Checkpointer
+
+        reg = MetricsRegistry()
+        ck = Checkpointer(str(tmp_path), keep_last=5, registry=reg)
+        wd = DivergenceWatchdog(checkpointer=ck, registry=reg,
+                                max_consecutive_skips=2,
+                                replay_dir=str(tmp_path / "replay"))
+        params = _params()
+        tk, tg = _data()
+        step = make_single_device_train_step(H, attn_impl="dense",
+                                             guard=True)
+        for i in range(1, 3):
+            params, loss, gm = step(params, tk, tg)
+            assert wd.observe(i, loss, jax.device_get(gm)) == "ok"
+            ck.save(i, {"params": params})
+            wd.note_checkpoint(i)
+        saved = jax.device_get(params)  # the step-2 state
+        assert ck.last_good_step() == 2
+        # poison and diverge
+        params = _poison(params)
+        verdict = None
+        for i in range(3, 6):
+            params, loss, gm = step(params, tk, tg)
+            verdict = wd.observe(i, loss, jax.device_get(gm),
+                                 params=params,
+                                 batch={"tokens": tk, "targets": tg})
+            if verdict == "diverged":
+                break
+        assert verdict == "diverged"
+        assert wd.bundles and os.path.exists(wd.bundles[0])
+        state, got, _meta = wd.rollback({"params": _params()})
+        assert got == 2
+        assert _tree_bits_equal(state["params"], saved)
+        assert reg.counter("guard_rollbacks_total").value == 1
+        assert not wd.diverged
+        # resume-grade: two post-rollback steps equal the uninterrupted twin
+        a = jax.tree_util.tree_map(jnp.asarray, state["params"])
+        b = jax.tree_util.tree_map(jnp.asarray, saved)
+        ref = make_single_device_train_step(H, attn_impl="dense")
+        for i in range(2):
+            a, la, _ = step(a, tk, tg)
+            b, lb = ref(b, tk, tg)
+            assert abs(float(la) - float(lb)) <= 1e-6
+        for x, y in zip(jax.tree_util.tree_leaves(jax.device_get(a)),
+                        jax.tree_util.tree_leaves(jax.device_get(b))):
+            assert float(np.max(np.abs(x - y))) <= 1e-6
+
+
+# ------------------------------------------------------ replay forensics ----
+
+class TestReplayBundles:
+    def _nan_model(self):
+        from deeplearning4j_tpu.scaleout.elastic import (
+            SyntheticRegressionModel,
+        )
+
+        return SyntheticRegressionModel(d_in=4, d_hidden=8, batch=8,
+                                        lr=0.05, mesh_devices=1,
+                                        guard=True, nan_at_step=2)
+
+    def test_bundle_roundtrip_and_forensics(self, tmp_path):
+        model = self._nan_model()
+        p, _ = model.run_steps(model.init_params(), 0, 2, worker_seed=0)
+        x, y = model._batch_for(0, 2)
+        path = dump_replay_bundle(
+            str(tmp_path), 2, {"params": p, "batch": {"x": x, "y": y}},
+            {"worker": "w0", "rng_key": [0, 2]})
+        payload, meta = load_replay_bundle(path)
+        assert meta["step"] == 2 and meta["worker"] == "w0"
+        assert meta["rng_key"] == [0, 2]
+        np.testing.assert_array_equal(payload["batch"]["x"], x)
+        bad = [e for e in nonfinite_report(payload) if e["nonfinite"]]
+        assert [e["path"] for e in bad] == ["['batch']['x']"]
+        assert bad[0]["nonfinite"] == 1
+
+    def test_step_replay_cli_reproduces_nonfinite(self, tmp_path):
+        model = self._nan_model()
+        p, _ = model.run_steps(model.init_params(), 0, 2, worker_seed=0)
+        x, y = model._batch_for(0, 2)
+        path = dump_replay_bundle(
+            str(tmp_path), 2, {"params": p, "batch": {"x": x, "y": y}}, {})
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "step_replay.py"),
+             path, "--factory",
+             "deeplearning4j_tpu.scaleout.elastic:synthetic_replay",
+             "--kwargs-json",
+             json.dumps({"d_in": 4, "d_hidden": 8, "batch": 8,
+                         "lr": 0.05}),
+             "--expect-nonfinite", "--json"],
+            capture_output=True, text=True, timeout=120, cwd=REPO)
+        assert out.returncode == 0, out.stderr[-800:]
+        rep = json.loads(out.stdout)
+        assert rep["reproduced"] is True
+        assert rep["result"]["loss"] == "nan"
+        assert any(e["nonfinite"] for e in rep["forensics"])
+
+    def test_step_replay_cli_clean_bundle_fails_expectation(self, tmp_path):
+        """A finite replay under --expect-nonfinite is exit 1 — the gate
+        the fault tests rely on cannot pass vacuously."""
+        model = self._nan_model()
+        p = model.init_params()
+        x, y = model._batch_for(0, 0)  # step 0 is clean
+        path = dump_replay_bundle(
+            str(tmp_path), 0, {"params": p, "batch": {"x": x, "y": y}}, {})
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "step_replay.py"),
+             path, "--factory",
+             "deeplearning4j_tpu.scaleout.elastic:synthetic_replay",
+             "--kwargs-json",
+             json.dumps({"d_in": 4, "d_hidden": 8, "batch": 8,
+                         "lr": 0.05}),
+             "--expect-nonfinite"],
+            capture_output=True, text=True, timeout=120, cwd=REPO)
+        assert out.returncode == 1
+
+    def test_step_replay_cli_missing_bundle(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "step_replay.py"),
+             str(tmp_path / "nope.npz")],
+            capture_output=True, text=True, timeout=60, cwd=REPO)
+        assert out.returncode == 2
+
+    def test_lm_replay_factory(self, tmp_path):
+        """The flagship-LM replay factory reproduces a poisoned-params
+        non-finite loss from its bundle."""
+        from deeplearning4j_tpu.models.transformer_lm import lm_replay
+
+        poisoned = jax.device_get(_poison(_params()))
+        tk, tg = _data()
+        path = dump_replay_bundle(
+            str(tmp_path), 7,
+            {"params": poisoned,
+             "batch": {"tokens": np.asarray(tk), "targets": np.asarray(tg)}},
+            {})
+        payload, meta = load_replay_bundle(path)
+        result = lm_replay(H, attn_impl="dense")(payload)
+        assert not math.isfinite(result["loss"])
+
+    def test_watchdog_bundle_retention(self, tmp_path):
+        wd = DivergenceWatchdog(registry=MetricsRegistry(),
+                                max_consecutive_skips=100,
+                                replay_dir=str(tmp_path), max_bundles=2)
+        batch = {"x": np.ones((2, 2), np.float32)}
+        for i in range(4):
+            wd.observe(i, float("nan"), {"nonfinite": 1.0}, batch=batch)
+            wd.observe(100 + i, 1.0)  # close the burst so each skip dumps
+        assert len(wd.bundles) == 2
+        assert all(os.path.exists(p) for p in wd.bundles)
+        assert len(os.listdir(tmp_path)) == 2  # stale bundles deleted

@@ -800,3 +800,72 @@ class TestRegistryConcurrency:
         snap = reg.snapshot()
         names = [c["name"] for c in snap["counters"]]
         assert len(names) == len(set(names))
+
+
+# -------------------------------------------- nonfinite flagging (ISSUE 8) ----
+
+class TestNonfiniteReport:
+    """ISSUE 8 satellite: step_log.py preserves NaN/Inf as repr strings;
+    the summarizer and tools/telemetry_report.py must SHOUT about them
+    (a flagged ``nonfinite`` column) instead of silently dropping them."""
+
+    def _write_faulty_log(self, tmp_path):
+        path = str(tmp_path / "steps.jsonl")
+        with StepLogWriter(path) as w:
+            w.write(0, loss=2.0, grad_norm=1.5, nonfinite=0.0, clipped=0.0)
+            w.write(1, loss=float("nan"), grad_norm=float("inf"),
+                    nonfinite=1.0, clipped=0.0)
+            w.write(2, loss=1.8, grad_norm=1.2, nonfinite=0.0, clipped=1.0)
+            w.write(3, loss=float("-inf"), grad_norm=1.1, nonfinite=1.0,
+                    clipped=0.0)
+        return path
+
+    def test_summary_counts_nonfinite_values(self, tmp_path):
+        path = self._write_faulty_log(tmp_path)
+        summary = summarize_step_log(read_step_log(path))
+        assert summary["nonfinite"] == {"loss": 2, "grad_norm": 1}
+        # guard flags roll up to skipped/clipped step totals
+        assert summary["skipped_steps"] == 2
+        assert summary["clipped_steps"] == 1
+        # finite values still summarize (the strings are excluded)
+        assert summary["loss"] == {"first": 2.0, "last": 1.8}
+
+    def test_summary_counts_raw_float_nonfinite(self):
+        # records built in-process (bench detail path) carry raw floats
+        summary = summarize_step_log([
+            {"ts": 0.0, "step": 0, "loss": 1.0},
+            {"ts": 1.0, "step": 1, "loss": float("nan")},
+        ])
+        assert summary["nonfinite"] == {"loss": 1}
+
+    def test_clean_log_has_no_nonfinite_block(self, tmp_path):
+        path = str(tmp_path / "steps.jsonl")
+        with StepLogWriter(path) as w:
+            w.write(0, loss=2.0)
+            w.write(1, loss=1.5)
+        summary = summarize_step_log(read_step_log(path))
+        assert "nonfinite" not in summary
+        assert "skipped_steps" not in summary
+
+    def test_report_table_shouts(self, tmp_path):
+        path = self._write_faulty_log(tmp_path)
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "telemetry_report.py"), path],
+            capture_output=True, text=True, timeout=60, cwd=REPO)
+        assert out.returncode == 0, out.stderr
+        assert "!! NONFINITE" in out.stdout
+        assert "lossx2" in out.stdout and "grad_normx1" in out.stdout
+        assert "skipped_steps" in out.stdout
+        assert "WARNING" in out.stdout
+
+    def test_report_json_carries_nonfinite(self, tmp_path):
+        path = self._write_faulty_log(tmp_path)
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "telemetry_report.py"), path,
+             "--json"],
+            capture_output=True, text=True, timeout=60, cwd=REPO)
+        assert out.returncode == 0, out.stderr
+        summary = json.loads(out.stdout)
+        assert summary["nonfinite"] == {"loss": 2, "grad_norm": 1}

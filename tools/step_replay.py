@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Deterministically re-execute a guardrails replay bundle for forensics.
+
+Usage:
+    python tools/step_replay.py BUNDLE.npz [--json]
+    python tools/step_replay.py BUNDLE.npz \
+        --factory deeplearning4j_tpu.scaleout.elastic:synthetic_replay \
+        --kwargs-json '{"d_in": 8, "d_hidden": 16}' [--expect-nonfinite]
+
+A bundle is what ``optimize/guardrails.dump_replay_bundle`` (or the
+``DivergenceWatchdog``) wrote when a train step went non-finite: one
+atomic npz holding the pre-step params + batch plus meta (step id, RNG
+key, observed loss). This CLI:
+
+1. loads the bundle and prints its meta + a per-leaf non-finite forensics
+   table (which leaf of the batch/params carries the poison, how many
+   elements, the finite min/max around them);
+2. with ``--factory pkg.module:fn`` (the same spec convention as the
+   elastic worker CLI), re-executes the step: the factory is called with
+   ``--kwargs-json`` and must return ``run(payload) -> dict`` of result
+   scalars (loss, grad_norm, ...) — e.g.
+   ``deeplearning4j_tpu.scaleout.elastic:synthetic_replay`` or
+   ``deeplearning4j_tpu.models.transformer_lm:lm_replay``;
+3. reports whether the non-finite result REPRODUCED. ``--expect-nonfinite``
+   turns a clean replay into exit code 1 (the bench's recovery demo and
+   the fault-matrix tests pin reproduction with it).
+
+Exit codes: 0 ok, 1 ``--expect-nonfinite`` not reproduced, 2 bad bundle
+path / unreadable bundle.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu.optimize.guardrails import (  # noqa: E402
+    load_replay_bundle,
+    nonfinite_report,
+)
+
+
+def _resolve_factory(spec: str, kwargs: dict):
+    module_name, _, attr = spec.partition(":")
+    factory = getattr(importlib.import_module(module_name), attr)
+    return factory(**kwargs)
+
+
+def _result_nonfinite(result: dict) -> bool:
+    return any(isinstance(v, float) and not math.isfinite(v)
+               for v in result.values())
+
+
+def format_report(meta: dict, forensics: list, result, path: str) -> str:
+    lines = [f"step replay — {path}"]
+    lines.append("-" * max(len(lines[0]), 40))
+    for k in sorted(meta):
+        lines.append(f"meta {k:<18} {meta[k]!r}")
+    poisoned = [e for e in forensics if e.get("nonfinite")]
+    lines.append(f"leaves: {len(forensics)} total, {len(poisoned)} with "
+                 "non-finite values")
+    for e in poisoned:
+        rng = ""
+        if "finite_min" in e:
+            rng = f"  finite range [{e['finite_min']:.6g}, " \
+                  f"{e['finite_max']:.6g}]"
+        lines.append(f"  !! {e['path']}  {e['dtype']}{e['shape']}  "
+                     f"{e['nonfinite']} non-finite{rng}")
+    if result is not None:
+        lines.append("re-execution:")
+        for k in sorted(result):
+            lines.append(f"  {k:<18} {result[k]!r}")
+        lines.append("non-finite result REPRODUCED"
+                     if _result_nonfinite(result)
+                     else "replay came out FINITE (fault not reproduced)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("bundle", help="replay bundle (.npz) path")
+    ap.add_argument("--factory", default=None,
+                    help="pkg.module:fn returning run(payload) -> dict; "
+                         "re-executes the faulting step")
+    ap.add_argument("--kwargs-json", default="{}",
+                    help="JSON kwargs for the factory")
+    ap.add_argument("--expect-nonfinite", action="store_true",
+                    help="exit 1 unless the re-executed step reproduces a "
+                         "non-finite result")
+    ap.add_argument("--json", action="store_true",
+                    help="emit a JSON report instead of the table")
+    args = ap.parse_args(argv)
+    if not os.path.isfile(args.bundle):
+        print(f"no such replay bundle: {args.bundle}", file=sys.stderr)
+        return 2
+    try:
+        payload, meta = load_replay_bundle(args.bundle)
+    except (ValueError, OSError, KeyError) as exc:
+        print(f"unreadable replay bundle {args.bundle}: {exc}",
+              file=sys.stderr)
+        return 2
+    forensics = nonfinite_report(payload)
+    result = None
+    if args.factory:
+        run = _resolve_factory(args.factory, json.loads(args.kwargs_json))
+        result = run(payload)
+    if args.json:
+        print(json.dumps({
+            "bundle": args.bundle,
+            "meta": meta,
+            "forensics": forensics,
+            "result": ({k: repr(v) if isinstance(v, float)
+                        and not math.isfinite(v) else v
+                        for k, v in result.items()}
+                       if result is not None else None),
+            "reproduced": (_result_nonfinite(result)
+                           if result is not None else None),
+        }, indent=1))
+    else:
+        print(format_report(meta, forensics, result, args.bundle))
+    if args.expect_nonfinite:
+        if result is None:
+            print("--expect-nonfinite needs --factory to re-execute",
+                  file=sys.stderr)
+            return 1
+        if not _result_nonfinite(result):
+            print("expected a non-finite replay result but the step came "
+                  "out finite", file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

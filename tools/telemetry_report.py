@@ -46,9 +46,24 @@ def format_report(summary: dict, path: str) -> str:
         load = summary["router_load_mean"]
         rows.append(("router load (mean/expert)",
                      " ".join(f"e{i}={v}" for i, v in enumerate(load))))
+    # numerical faults SHOUT (ISSUE 8): step_log preserves NaN/Inf as repr
+    # strings so the JSONL stays parseable; a report that silently dropped
+    # them would hide exactly the steps worth investigating
+    bad = summary.get("nonfinite")
+    if bad:
+        rows.append(("!! NONFINITE values", " ".join(
+            f"{k}x{n}" for k, n in sorted(bad.items()))))
+    for key in ("skipped_steps", "clipped_steps"):
+        if summary.get(key):
+            rows.append((f"!! guard {key}", str(summary[key])))
     width = max(len(r[0]) for r in rows)
     lines = [f"telemetry report — {path}", "-" * (width + 24)]
     lines += [f"{name:<{width}}  {value}" for name, value in rows]
+    if bad:
+        lines.append(
+            f"WARNING: {sum(bad.values())} non-finite metric value(s) in "
+            "this log — see the nonfinite row; replay bundles (if the "
+            "watchdog was armed) hold the faulting steps")
     return "\n".join(lines)
 
 
