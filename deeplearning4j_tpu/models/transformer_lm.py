@@ -371,7 +371,8 @@ def shard_lm_batch(tokens: Array, targets: Array, mesh: Mesh) -> tuple:
 
 
 def _make_sgd_step(loss_fn, lr: float, with_metrics: bool,
-                   donate: bool = False, guard=None):
+                   donate: bool = False, guard=None, profile=None,
+                   profile_label: str = "lm_step"):
     """jitted SGD step; with metrics the loss fn returns (loss, aux) and the
     step appends the grad/param-norm block — the loss+grad graph itself is
     the SAME ops either way (bit-parity pinned in tests/test_telemetry.py).
@@ -388,8 +389,22 @@ def _make_sgd_step(loss_fn, lr: float, with_metrics: bool,
     (``nonfinite``/``clipped``/``guard_grad_norm`` device scalars) as a
     third output, or merged into the metrics dict when ``with_metrics``;
     on clean batches it is bit-identical to the unguarded step (pinned in
-    tests/test_guardrails.py) and remains donate-safe."""
+    tests/test_guardrails.py) and remains donate-safe.
+
+    ``profile`` (ISSUE 9; ``True`` or a label string) wraps the jitted
+    step in ``telemetry.xprofile.ProfiledStep``: the first call captures a
+    :class:`~deeplearning4j_tpu.telemetry.xprofile.StepProfile` (XLA
+    cost/memory analysis + HLO collective inventory) on
+    ``step.step_profile`` and records it in the default profile store;
+    every call executes the same compiled program, so the profiling cost
+    is compile-time-only."""
     donate_argnums = (0,) if donate else ()
+
+    def _seam(step):
+        from deeplearning4j_tpu.telemetry.xprofile import maybe_profiled
+
+        return maybe_profiled(step, profile, profile_label)
+
     if guard is not None:
         from deeplearning4j_tpu.optimize.guardrails import guarded_sgd_update
     if not with_metrics:
@@ -401,7 +416,7 @@ def _make_sgd_step(loss_fn, lr: float, with_metrics: bool,
                 return jax.tree_util.tree_map(lambda p, g: p - lr * g,
                                               params, grads), loss
 
-            return step
+            return _seam(step)
 
         @partial(jax.jit, donate_argnums=donate_argnums)
         def step(params, tokens, targets):
@@ -411,7 +426,7 @@ def _make_sgd_step(loss_fn, lr: float, with_metrics: bool,
                                                 guard)
             return new_params, loss, gm
 
-        return step
+        return _seam(step)
 
     from deeplearning4j_tpu.telemetry.metrics import train_step_metrics
 
@@ -431,7 +446,7 @@ def _make_sgd_step(loss_fn, lr: float, with_metrics: bool,
                    **gm}
         return new_params, loss, metrics
 
-    return step
+    return _seam(step)
 
 
 def make_composed_train_step(mesh: Mesh, n_heads: int, capacity: int,
@@ -440,7 +455,8 @@ def make_composed_train_step(mesh: Mesh, n_heads: int, capacity: int,
                              attn_impl: Optional[str] = None,
                              moe_impl: Optional[str] = None,
                              with_metrics: bool = False,
-                             donate: bool = False, guard=None):
+                             donate: bool = False, guard=None,
+                             profile=None):
     """SGD step over the composed mesh: step(params, tokens, targets) ->
     (new_params, loss). Shard inputs with shard_lm_params/shard_lm_batch
     first; GSPMD + the shard_map transposes insert every collective
@@ -457,32 +473,44 @@ def make_composed_train_step(mesh: Mesh, n_heads: int, capacity: int,
     ``guard=True`` (or a ``GuardConfig``) arms the numerical guardrails:
     skip-on-nonfinite + optional global-norm clip inside the same jitted
     program, returning the guard block as a third output (merged into
-    metrics when ``with_metrics``); see optimize/guardrails.py."""
+    metrics when ``with_metrics``); see optimize/guardrails.py.
+
+    ``profile=True`` (or a label string) captures a compile-time
+    ``StepProfile`` on ``step.step_profile`` — cost/memory analysis plus
+    the HLO collective inventory, which on this mesh shows the grad
+    all-reduces, the ring collective-permutes (when "sp" is present), and
+    the MoE all_to_all exchange (when the alltoall dispatch resolves);
+    see telemetry/xprofile.py."""
     from deeplearning4j_tpu.optimize.guardrails import GuardConfig
 
     loss_fn = composed_loss_fn(mesh, n_heads, capacity, top_k, aux_weight,
                                attn_impl=attn_impl, moe_impl=moe_impl,
                                with_metrics=with_metrics)
+    label = "lm_composed[" + "x".join(mesh.axis_names) + "]"
     return _make_sgd_step(loss_fn, lr, with_metrics, donate=donate,
-                          guard=GuardConfig.coerce(guard))
+                          guard=GuardConfig.coerce(guard), profile=profile,
+                          profile_label=label)
 
 
 def make_single_device_train_step(n_heads: int, lr: float = 0.1,
                                   top_k: int = 2, aux_weight: float = 1e-2,
                                   attn_impl: Optional[str] = None,
                                   with_metrics: bool = False,
-                                  donate: bool = False, guard=None):
+                                  donate: bool = False, guard=None,
+                                  profile=None):
     """The dense twin of make_composed_train_step (parity oracle when
     called with ``attn_impl="dense"``; the flagship single-chip bench path
-    with the default auto core). ``with_metrics``/``donate``/``guard`` as
-    on the composed builder (bench hot loops pass donate=True; the
-    guardrails bench stage passes guard=True on top)."""
+    with the default auto core). ``with_metrics``/``donate``/``guard``/
+    ``profile`` as on the composed builder (bench hot loops pass
+    donate=True; the guardrails bench stage passes guard=True on top; the
+    profile stage passes profile=True)."""
     from deeplearning4j_tpu.optimize.guardrails import GuardConfig
 
     loss_fn = dense_loss_fn(n_heads, top_k, aux_weight, attn_impl=attn_impl,
                             with_metrics=with_metrics)
     return _make_sgd_step(loss_fn, lr, with_metrics, donate=donate,
-                          guard=GuardConfig.coerce(guard))
+                          guard=GuardConfig.coerce(guard), profile=profile,
+                          profile_label="lm_single_device")
 
 
 # ----------------------------------------------------------------- dp×pp ----

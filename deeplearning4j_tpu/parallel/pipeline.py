@@ -272,7 +272,8 @@ def make_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
                              mesh: Mesh, axis: str = PIPE_AXIS,
                              lr: float = 0.1,
                              batch_axis: "str | None" = None,
-                             with_metrics: bool = False, guard=None):
+                             with_metrics: bool = False, guard=None,
+                             profile=None):
     """SGD train step over the pipelined stack.
 
     loss = mean over microbatches of ``loss_fn(y, labels_mb)`` on the
@@ -293,13 +294,20 @@ def make_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
     metrics is the guard block (plus the telemetry block when
     ``with_metrics``); bit-identical to the unguarded step on clean
     microbatches (pinned in tests/test_guardrails.py).
+
+    ``profile=True`` (or a label string) captures a compile-time
+    ``StepProfile`` on ``step.step_profile`` (telemetry/xprofile.py) —
+    its collective inventory shows the stage-handoff ppermutes as
+    collective-permute ops plus the output/grad psums of the schedule.
     """
     from deeplearning4j_tpu.optimize.guardrails import (
         GuardConfig,
         guarded_sgd_update,
     )
+    from deeplearning4j_tpu.telemetry.xprofile import maybe_profiled
 
     guard = GuardConfig.coerce(guard)
+    label = f"pipeline[{axis}" + (f"x{batch_axis}]" if batch_axis else "]")
 
     def loss_of(params, x_mbs, y_mbs):
         outs = pipeline_apply(params, x_mbs, stage_fn, mesh, axis,
@@ -316,7 +324,7 @@ def make_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
                 lambda p, g: p - lr * g, params, grads)
             return new_params, loss
 
-        return step
+        return maybe_profiled(step, profile, label)
 
     from deeplearning4j_tpu.telemetry.metrics import train_step_metrics
 
@@ -339,4 +347,4 @@ def make_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
             })
         return new_params, loss, metrics
 
-    return step
+    return maybe_profiled(step, profile, label)

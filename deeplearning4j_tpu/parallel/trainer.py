@@ -170,7 +170,8 @@ def _local_grad_step(conf, params, states, iteration, x, y, w, key,
 
 def make_sync_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
                          ablate_collectives: bool = False,
-                         with_metrics: bool = False, guard=None):
+                         with_metrics: bool = False, guard=None,
+                         profile=None):
     """Per-step averaging: grads AllReduced every iteration.
 
     step(params, states, iteration, x, y, w, key) — ``w`` is the per-row
@@ -193,8 +194,14 @@ def make_sync_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
     finiteness test runs on the post-AllReduce score/grads, so every
     replica takes the same skip decision. Clean steps stay bit-identical
     (pinned in tests/test_guardrails.py).
+
+    ``profile=True`` (or a label string) captures a compile-time
+    ``StepProfile`` on ``step.step_profile`` (telemetry/xprofile.py) —
+    its collective inventory pins the ONE fused gradient all-reduce this
+    step is supposed to issue (the scaling_bench invariant).
     """
     from deeplearning4j_tpu.optimize.guardrails import GuardConfig
+    from deeplearning4j_tpu.telemetry.xprofile import maybe_profiled
 
     guard = GuardConfig.coerce(guard)
 
@@ -212,7 +219,8 @@ def make_sync_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
         out_specs=out_specs,
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(0, 1))
+    return maybe_profiled(jax.jit(sharded, donate_argnums=(0, 1)), profile,
+                          f"dp_sync[{mesh.shape[DATA_AXIS]}]")
 
 
 def make_local_fit_step(conf: MultiLayerConfiguration, mesh: Mesh,

@@ -189,13 +189,20 @@ class SyntheticRegressionModel(ElasticModel):
     elastic NaN matrix: ``nan_at_step`` poisons the batch of that global
     step index with a NaN (restricted to ``nan_worker_seed`` when set) —
     a pure function of (worker_seed, step), so ``simulate_elastic`` with
-    the same knobs is still an exact oracle."""
+    the same knobs is still an exact oracle.
+
+    Profiling (ISSUE 9): ``profile=True`` wraps the jitted mesh step in
+    ``telemetry.xprofile.ProfiledStep`` — after the first ``run_steps``
+    the compile-time :class:`StepProfile` (cost/memory analysis + the
+    grad all-reduce inventory of the data-parallel mesh) is exposed as
+    ``model.step_profile``."""
 
     def __init__(self, d_in: int = 8, d_hidden: int = 16, batch: int = 32,
                  lr: float = 0.05, seed: int = 0, mesh_devices: int = 2,
                  guard: bool = False, clip_norm: Optional[float] = None,
                  nan_at_step: Optional[int] = None,
-                 nan_worker_seed: Optional[int] = None):
+                 nan_worker_seed: Optional[int] = None,
+                 profile: bool = False):
         self.d_in, self.d_hidden = int(d_in), int(d_hidden)
         self.batch, self.lr, self.seed = int(batch), float(lr), int(seed)
         self.mesh_devices = int(mesh_devices)
@@ -203,6 +210,7 @@ class SyntheticRegressionModel(ElasticModel):
         self.clip_norm = clip_norm
         self.nan_at_step = nan_at_step
         self.nan_worker_seed = nan_worker_seed
+        self.profile = profile
         self.skipped_steps = 0
         self._step = None
         self._mesh = None
@@ -271,7 +279,16 @@ class SyntheticRegressionModel(ElasticModel):
                                              guard_cfg)
                 return new, loss, gm["nonfinite"]
 
-        self._step = jax.jit(step, donate_argnums=(0,))
+        from deeplearning4j_tpu.telemetry.xprofile import maybe_profiled
+
+        self._step = maybe_profiled(jax.jit(step, donate_argnums=(0,)),
+                                    self.profile, "elastic_worker")
+
+    @property
+    def step_profile(self):
+        """The compile-time StepProfile once a profiled step has run
+        (None before the first ``run_steps`` or without ``profile=True``)."""
+        return getattr(self._step, "step_profile", None)
 
     def _batch_for(self, worker_seed: int, step_index: int):
         import jax

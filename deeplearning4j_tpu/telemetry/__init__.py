@@ -15,7 +15,12 @@ Three layers, one subsystem:
   the elastic control plane (context propagation over the tracker frame
   protocol and blob metas) + a per-process crash flight recorder dumped
   on error/SIGTERM and checkpointed write-ahead at round boundaries —
-  merged into round timelines by tools/trace_report.py.
+  merged into round timelines by tools/trace_report.py;
+- **performance attribution** (xprofile.py, ISSUE 9): compile-time
+  introspection of every jitted step behind the ``profile=`` seam —
+  XLA cost/memory analysis, HLO collective inventory, measured-MFU /
+  roofline attribution, live memory watermarks, served at
+  ``/api/profile`` and reported by tools/profile_report.py.
 
 The listener chain bridges in via optimize/listeners.MetricsIterationListener
 and the scaleout counters via the statetracker registry mirror.
@@ -56,6 +61,16 @@ from deeplearning4j_tpu.telemetry.step_log import (
     read_step_log,
     summarize_step_log,
 )
+from deeplearning4j_tpu.telemetry.xprofile import (
+    MemoryWatermarkSampler,
+    ProfiledStep,
+    ProfileStore,
+    StepProfile,
+    attribute,
+    default_profile_store,
+    profile_compiled,
+    profile_lowered,
+)
 
 __all__ = [
     "Counter",
@@ -63,12 +78,20 @@ __all__ = [
     "DEFAULT_INTERVAL",
     "Gauge",
     "Histogram",
+    "MemoryWatermarkSampler",
     "MetricsRegistry",
     "PROMETHEUS_CONTENT_TYPE",
+    "ProfileStore",
+    "ProfiledStep",
     "Span",
     "StepLogWriter",
+    "StepProfile",
     "Tracer",
     "TrainTelemetry",
+    "attribute",
+    "default_profile_store",
+    "profile_compiled",
+    "profile_lowered",
     "current_trace_context",
     "default_registry",
     "get_tracer",

@@ -1,0 +1,612 @@
+"""Compiled-step performance-attribution profiler (ISSUE 9).
+
+The observability stack so far answers "is training healthy?" (metrics,
+traces, guardrails); this module answers "where does the step time, memory,
+and network go?" — by introspecting the EXACT program XLA compiled rather
+than trusting hand-maintained analytic tables:
+
+- ``profile_compiled(step, *args)`` lowers + compiles a jitted step once
+  and returns a :class:`StepProfile`: XLA ``cost_analysis()`` FLOPs and
+  bytes-accessed, ``memory_analysis()`` argument/output/temp/alias bytes
+  (explicit ``None`` where a backend does not report them), compile wall
+  time, donation status parsed from the entry module's
+  ``input_output_alias``, and an **HLO collective inventory** — every
+  all-reduce / all-gather / all-to-all / collective-permute /
+  reduce-scatter in the compiled module with its payload bytes, replica
+  groups, and an analytic ring-convention wire-byte estimate.
+- ``attribute(profile, step_seconds)`` fuses a profile with a MEASURED
+  per-step wall time into derived attribution: measured MFU,
+  HBM-bandwidth utilization, roofline position (arithmetic intensity vs
+  the ridge point → compute- / memory- / comm-bound), and the comm
+  fraction implied by the collective inventory.
+- ``ProfiledStep`` is the ``profile=`` seam the train-step builders wrap
+  their jitted step in (mirroring ``attn_impl``/``with_metrics``/
+  ``guard``): the FIRST call runs the ahead-of-time lower→compile path,
+  captures the profile, and every call — including the first — executes
+  the SAME compiled executable, so profiling is compile-time-only and the
+  steady-state step stays one dispatch (<5% budget pinned by the bench
+  ``profile`` stage). Input-signature drift falls back to the plain jit
+  cache instead of failing the loop.
+- ``ProfileStore`` keeps the last profile per label and mirrors the
+  headline numbers into the PR 2 metrics registry as ``profile_*``
+  gauges; ``UiServer.attach_profiles`` serves it at ``/api/profile``.
+- ``MemoryWatermarkSampler`` samples ``device_memory_stats`` on a
+  background thread, exporting live ``profile_memory_*`` gauges plus its
+  own high watermark — the headroom signal the ZeRO roadmap item needs.
+  Backends without memory_stats (CPU) degrade to empty watermarks, never
+  errors.
+
+Wire-byte convention (documented once, used everywhere): for an op whose
+printed RESULT buffer is B bytes over a replica group of g devices,
+
+    all-reduce          2·(g−1)/g · B     (ring reduce-scatter+all-gather)
+    all-gather          (g−1)/g · B       (B is the gathered result)
+    reduce-scatter      (g−1) · B         (B is the 1/g scattered result)
+    all-to-all          (g−1)/g · B       (1/g of the buffer stays local)
+    collective-permute  B                 (one neighbor hop)
+
+These are per-device estimates of bytes on the wire, the same convention
+as bench.py's MoE comm model — analytic, not measured; XProf traces remain
+the measured truth.
+
+FLOPs convention caveat (load-bearing — verified on this toolchain in
+tests/test_xprofile.py): XLA's ``HloCostAnalysis`` counts a while/scan
+BODY ONCE, independent of trip count. A program that scans L decoder
+layers (or T LSTM timesteps, or the blockwise-attention K/V blocks)
+therefore reports the single-iteration FLOPs, not L× them.
+``StepProfile.flops`` keeps XLA's number verbatim; consumers that compare
+against per-sample analytic tables must either cross-check at trip count
+1 (what the tier-1 FLOPs-table test does) or scan-adjust the analytic
+side (what bench.py's profile blobs do, with both numbers recorded).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "CollectiveOp",
+    "MemoryWatermarkSampler",
+    "ProfileStore",
+    "ProfiledStep",
+    "StepProfile",
+    "attribute",
+    "default_profile_store",
+    "maybe_profiled",
+    "parse_collectives",
+    "profile_compiled",
+    "profile_lowered",
+    "summarize_collectives",
+]
+
+# bytes per element for the HLO shape dtypes this repo's programs produce
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+# one HLO shape literal: dtype[dims]{layout}? — e.g. f32[4,512]{1,0}
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\](?:\{[^}]*\})?")
+
+# a collective op DEFINITION line: "%name = <shape(s)> <kind>(operands...)"
+# -start variants count (async pair); -done lines don't define new traffic.
+_COLLECTIVE_RE = re.compile(
+    r"=\s+(\([^)]*\)|[a-z][a-z0-9]*\[[0-9,]*\](?:\{[^}]*\})?)\s+"
+    r"(all-reduce|all-gather|all-to-all|collective-permute|reduce-scatter)"
+    r"(-start)?\(")
+
+_REPLICA_GROUPS_RE = re.compile(r"replica_groups=\{(\{[^=]*?\})\}")
+_SOURCE_TARGET_RE = re.compile(r"source_target_pairs=\{(\{[^=]*?\})\}")
+
+# entry-module donation map: input_output_alias={ {0}: (0, {}, may-alias) }
+_ALIAS_ARG_RE = re.compile(r"\((\d+),\s*\{[^}]*\},\s*(?:may|must)-alias\)")
+
+# hardware model for the derived attribution (TPU v5e; see bench.py's
+# measured precision notes). Callers on other parts pass their own peaks.
+DEFAULT_PEAK_FLOPS = 197e12        # bf16 MXU peak per chip
+DEFAULT_HBM_BYTES_PER_SEC = 819e9  # v5e HBM bandwidth per chip
+DEFAULT_ICI_BYTES_PER_SEC = 45e9   # v5e ICI per direction per link
+
+
+def _shape_bytes(shape_text: str) -> int:
+    """Total bytes of one shape literal or a tuple of them."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape_text):
+        per = _DTYPE_BYTES.get(dtype)
+        if per is None:  # opaque/token shapes carry no payload
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * per
+    return total
+
+
+def _group_sizes(op_line: str) -> List[int]:
+    """Replica-group sizes of a collective line (source_target_pairs for
+    collective-permute: the ring a permute cycles over)."""
+    m = _REPLICA_GROUPS_RE.search(op_line)
+    if m:
+        return [len([d for d in grp.split(",") if d.strip() != ""])
+                for grp in re.findall(r"\{([^{}]*)\}", m.group(1))]
+    m = _SOURCE_TARGET_RE.search(op_line)
+    if m:
+        # pairs {{0,1},{1,0}} form cycles; the per-device traffic of one
+        # permute hop is payload-sized regardless, so record the pair count
+        n_pairs = len(re.findall(r"\{([^{}]*)\}", m.group(1)))
+        return [n_pairs] if n_pairs else []
+    return []
+
+
+def _wire_bytes(kind: str, payload: int, group: int) -> float:
+    """Per-device analytic wire bytes (ring convention, module docstring)."""
+    if group <= 1 and kind != "collective-permute":
+        return 0.0
+    if kind == "all-reduce":
+        return 2.0 * (group - 1) / group * payload
+    if kind == "all-gather":
+        return (group - 1) / group * payload
+    if kind == "reduce-scatter":
+        return float((group - 1) * payload)
+    if kind == "all-to-all":
+        return (group - 1) / group * payload
+    if kind == "collective-permute":
+        return float(payload)
+    return 0.0
+
+
+@dataclass
+class CollectiveOp:
+    """One collective in the compiled HLO."""
+
+    kind: str
+    payload_bytes: int
+    group_size: int
+    n_groups: int
+    wire_bytes: float
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "payload_bytes": self.payload_bytes,
+                "group_size": self.group_size, "n_groups": self.n_groups,
+                "wire_bytes": round(self.wire_bytes, 1)}
+
+
+def parse_collectives(hlo_text: str) -> List[CollectiveOp]:
+    """Collective inventory of a compiled HLO module's text."""
+    ops: List[CollectiveOp] = []
+    for line in hlo_text.splitlines():
+        m = _COLLECTIVE_RE.search(line)
+        if not m:
+            continue
+        shape_text, kind = m.group(1), m.group(2)
+        payload = _shape_bytes(shape_text)
+        sizes = _group_sizes(line)
+        group = max(sizes) if sizes else 1
+        ops.append(CollectiveOp(
+            kind=kind, payload_bytes=payload, group_size=group,
+            n_groups=len(sizes) or 1,
+            wire_bytes=_wire_bytes(kind, payload, group)))
+    return ops
+
+
+def summarize_collectives(ops: List[CollectiveOp]) -> Dict[str, Dict]:
+    """Per-kind aggregation: count, total payload/wire bytes, group sizes."""
+    out: Dict[str, Dict] = {}
+    for op in ops:
+        agg = out.setdefault(op.kind, {
+            "count": 0, "payload_bytes": 0, "wire_bytes": 0.0,
+            "group_sizes": []})
+        agg["count"] += 1
+        agg["payload_bytes"] += op.payload_bytes
+        agg["wire_bytes"] += op.wire_bytes
+        if op.group_size not in agg["group_sizes"]:
+            agg["group_sizes"].append(op.group_size)
+    for agg in out.values():
+        agg["wire_bytes"] = round(agg["wire_bytes"], 1)
+        agg["group_sizes"].sort()
+    return out
+
+
+@dataclass
+class StepProfile:
+    """What XLA compiled for one jitted step, captured at compile time.
+
+    Memory fields are ``None`` — explicitly, never silently zero — when the
+    backend's ``memory_analysis`` does not report them (pinned in
+    tests/test_xprofile.py). ``collectives`` is the per-kind summary;
+    ``collective_ops`` keeps the per-op records (bounded to the first
+    ``_MAX_OPS_KEPT`` for JSON-size sanity; counts/totals stay exact).
+    """
+
+    label: str
+    platform: str
+    flops: Optional[float] = None
+    transcendentals: Optional[float] = None
+    bytes_accessed: Optional[float] = None
+    argument_bytes: Optional[int] = None
+    output_bytes: Optional[int] = None
+    temp_bytes: Optional[int] = None
+    alias_bytes: Optional[int] = None
+    generated_code_bytes: Optional[int] = None
+    peak_bytes: Optional[int] = None
+    compile_seconds: Optional[float] = None
+    donated_args: int = 0
+    collectives: Dict[str, Dict] = field(default_factory=dict)
+    collective_ops: List[Dict] = field(default_factory=list)
+    collective_wire_bytes: float = 0.0
+    recorded_at: Optional[float] = None
+
+    _MAX_OPS_KEPT = 32
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {k: v for k, v in self.__dict__.items()
+             if not k.startswith("_")}
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "StepProfile":
+        known = {f for f in cls.__dataclass_fields__}  # type: ignore[attr-defined]
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+
+def _cost_dict(compiled) -> Dict[str, float]:
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return dict(ca or {})
+
+
+def _memory_fields(compiled) -> Dict[str, Optional[int]]:
+    """argument/output/temp/alias/generated-code bytes, None where absent."""
+    names = {
+        "argument_bytes": "argument_size_in_bytes",
+        "output_bytes": "output_size_in_bytes",
+        "temp_bytes": "temp_size_in_bytes",
+        "alias_bytes": "alias_size_in_bytes",
+        "generated_code_bytes": "generated_code_size_in_bytes",
+    }
+    out: Dict[str, Optional[int]] = {k: None for k in names}
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        mem = None
+    if mem is None:
+        return out
+    for field_name, attr in names.items():
+        val = getattr(mem, attr, None)
+        out[field_name] = int(val) if val is not None else None
+    return out
+
+
+def profile_lowered(lowered, label: str = "step",
+                    compiled=None,
+                    compile_seconds: Optional[float] = None) -> StepProfile:
+    """Profile an already-``lower()``-ed jitted call. Compiles it (timing
+    the compile) unless ``compiled`` is passed; returns the
+    :class:`StepProfile`. The compiled executable is stashed on the
+    profile as ``profile._compiled`` for AOT callers (ProfiledStep)."""
+    import jax
+
+    if compiled is None:
+        t0 = time.perf_counter()
+        compiled = lowered.compile()
+        # graftlint: allow[untimed-dispatch] compile() is host-synchronous — nothing is enqueued inside this window
+        compile_seconds = time.perf_counter() - t0
+
+    cost = _cost_dict(compiled)
+    mem = _memory_fields(compiled)
+    try:
+        hlo_text = compiled.as_text()
+    except Exception:
+        hlo_text = ""
+    ops = parse_collectives(hlo_text)
+    donated = 0
+    for line in hlo_text.splitlines():
+        if "input_output_alias=" in line:
+            donated = len(set(_ALIAS_ARG_RE.findall(line)))
+            break
+
+    peak = None
+    if mem["temp_bytes"] is not None:
+        # the residency estimate while the program runs: live arguments +
+        # outputs + temps, minus the donated (aliased) overlap
+        peak = ((mem["argument_bytes"] or 0) + (mem["output_bytes"] or 0)
+                + mem["temp_bytes"] - (mem["alias_bytes"] or 0))
+
+    prof = StepProfile(
+        label=label,
+        platform=jax.devices()[0].platform,
+        flops=cost.get("flops"),
+        transcendentals=cost.get("transcendentals"),
+        bytes_accessed=cost.get("bytes accessed"),
+        compile_seconds=(round(compile_seconds, 4)
+                         if compile_seconds is not None else None),
+        donated_args=donated,
+        collectives=summarize_collectives(ops),
+        collective_ops=[op.to_dict()
+                        for op in ops[:StepProfile._MAX_OPS_KEPT]],
+        collective_wire_bytes=round(sum(op.wire_bytes for op in ops), 1),
+        peak_bytes=peak,
+        recorded_at=time.time(),
+        **mem,
+    )
+    prof._compiled = compiled  # type: ignore[attr-defined]  # AOT handle, excluded from to_dict
+    return prof
+
+
+def profile_compiled(fn, *args, label: str = "step",
+                     store: Optional["ProfileStore"] = None,
+                     **kwargs) -> StepProfile:
+    """Lower + compile a jitted callable against ``*args`` and profile the
+    result. This is the one-stop API the bench stages, tests, and the
+    ``profile=`` seam all use — ONE compile, no execution."""
+    prof = profile_lowered(fn.lower(*args, **kwargs), label=label)
+    if store is not None:
+        store.record(prof)
+    return prof
+
+
+# ------------------------------------------------------------- attribution ----
+
+def attribute(profile: StepProfile, step_seconds: float,
+              peak_flops: float = DEFAULT_PEAK_FLOPS,
+              hbm_bytes_per_sec: float = DEFAULT_HBM_BYTES_PER_SEC,
+              ici_bytes_per_sec: float = DEFAULT_ICI_BYTES_PER_SEC,
+              ) -> Dict[str, Any]:
+    """Fuse a compile-time profile with a MEASURED step time.
+
+    Returns measured MFU (XLA-counted FLOPs, not the analytic table),
+    HBM-bandwidth utilization, the roofline position (arithmetic
+    intensity vs the ridge point ``peak_flops / hbm_bw``), the comm
+    fraction implied by the collective wire bytes at ``ici_bytes_per_sec``,
+    and the resource whose implied time is largest (``bound``). All three
+    implied times are lower bounds — overlap means the real step can beat
+    their sum, which is exactly what the comm/compute-overlap roadmap item
+    will need this to show."""
+    step_seconds = max(float(step_seconds), 1e-12)
+    flops = profile.flops or 0.0
+    bytes_accessed = profile.bytes_accessed or 0.0
+    wire = profile.collective_wire_bytes or 0.0
+    t_compute = flops / peak_flops
+    t_memory = bytes_accessed / hbm_bytes_per_sec
+    t_comm = wire / ici_bytes_per_sec
+    implied = {"compute": t_compute, "memory": t_memory, "comm": t_comm}
+    bound = max(implied, key=lambda k: implied[k]) if any(
+        v > 0 for v in implied.values()) else "unknown"
+    ai = (flops / bytes_accessed) if bytes_accessed else None
+    ridge = peak_flops / hbm_bytes_per_sec
+    return {
+        "step_seconds": step_seconds,
+        "measured_mfu": flops / step_seconds / peak_flops,
+        "hbm_utilization": bytes_accessed / step_seconds / hbm_bytes_per_sec,
+        "comm_fraction": t_comm / step_seconds,
+        "arithmetic_intensity": ai,
+        "ridge_intensity": ridge,
+        "bound": bound,
+        "implied_seconds": implied,
+        "model": {"peak_flops": peak_flops,
+                  "hbm_bytes_per_sec": hbm_bytes_per_sec,
+                  "ici_bytes_per_sec": ici_bytes_per_sec},
+    }
+
+
+# ------------------------------------------------------------ profile store ----
+
+class ProfileStore:
+    """Last StepProfile per label + registry mirror.
+
+    ``record`` keeps the profile dict and mirrors the headline numbers
+    into the metrics registry as ``profile_flops`` / ``profile_peak_bytes``
+    / ``profile_collective_wire_bytes`` / ``profile_compile_seconds``
+    gauges labeled ``{"step": label}`` — so the Prometheus/UI export layer
+    (PR 2) serves them with zero extra ceremony. Thread-safe."""
+
+    def __init__(self, registry=None):
+        self._lock = threading.Lock()
+        self._profiles: Dict[str, Dict] = {}
+        self._registry = registry
+
+    def _mirror(self, prof: StepProfile) -> None:
+        reg = self._registry
+        if reg is None:
+            from deeplearning4j_tpu.telemetry.registry import default_registry
+
+            reg = default_registry()
+        labels = {"step": prof.label}
+        if prof.flops is not None:
+            reg.gauge("profile_flops", labels).set(prof.flops)
+        if prof.peak_bytes is not None:
+            reg.gauge("profile_peak_bytes", labels).set(prof.peak_bytes)
+        if prof.compile_seconds is not None:
+            reg.gauge("profile_compile_seconds",
+                      labels).set(prof.compile_seconds)
+        reg.gauge("profile_collective_wire_bytes",
+                  labels).set(prof.collective_wire_bytes)
+
+    def record(self, prof: StepProfile) -> None:
+        with self._lock:
+            self._profiles[prof.label] = prof.to_dict()
+        self._mirror(prof)
+
+    def get(self, label: str) -> Optional[Dict]:
+        with self._lock:
+            return self._profiles.get(label)
+
+    def snapshot(self) -> List[Dict]:
+        with self._lock:
+            return [self._profiles[k] for k in sorted(self._profiles)]
+
+
+_default_store: Optional[ProfileStore] = None
+_default_store_lock = threading.Lock()
+
+
+def default_profile_store() -> ProfileStore:
+    global _default_store
+    with _default_store_lock:
+        if _default_store is None:
+            _default_store = ProfileStore()
+        return _default_store
+
+
+# ------------------------------------------------------------ profile= seam ----
+
+class ProfiledStep:
+    """The ``profile=`` seam: wrap a jitted step so its FIRST call runs the
+    ahead-of-time ``lower → compile`` path once, captures the
+    :class:`StepProfile` (``self.step_profile``, also recorded in the
+    store), and EVERY call — including that first one — executes the same
+    compiled executable. Profiling cost is therefore compile-time-only;
+    the steady-state path is one attribute load + the AOT dispatch (the
+    bench ``profile`` stage pins the <5% budget).
+
+    The AOT executable is shape-pinned — an input-signature drift (new
+    batch shape, weak-type scalar) raises before execution; the wrapper
+    then falls back to the underlying jit cache so a training loop keeps
+    running (at the cost of the recompile the retrace guard exists to
+    catch)."""
+
+    def __init__(self, fn, label: str = "step",
+                 store: Optional[ProfileStore] = None):
+        self._fn = fn
+        self.label = label
+        self._store = store if store is not None else default_profile_store()
+        self._compiled = None
+        self.step_profile: Optional[StepProfile] = None
+        self.signature_fallbacks = 0
+
+    def __call__(self, *args):
+        if self._compiled is None:
+            prof = profile_compiled(self._fn, *args, label=self.label,
+                                    store=self._store)
+            self._compiled = prof._compiled  # type: ignore[attr-defined]
+            self.step_profile = prof
+        try:
+            return self._compiled(*args)
+        except TypeError:
+            # aval drift — raised BEFORE execution, so the args (donated or
+            # not) are intact; route through the jit cache instead
+            self.signature_fallbacks += 1
+            return self._fn(*args)
+
+    # AOT introspection passthroughs, so a ProfiledStep still quacks like
+    # the jitted step for the callers that lower it themselves
+    def lower(self, *args, **kwargs):
+        return self._fn.lower(*args, **kwargs)
+
+
+def maybe_profiled(fn, profile, label: str):
+    """Builder helper: wrap ``fn`` in a :class:`ProfiledStep` when
+    ``profile`` is truthy (a string overrides the label), else return
+    ``fn`` unchanged — the zero-cost default, like ``maybe_span``."""
+    if not profile:
+        return fn
+    return ProfiledStep(fn, label=profile if isinstance(profile, str)
+                        else label)
+
+
+# ------------------------------------------------------- memory watermarks ----
+
+class MemoryWatermarkSampler:
+    """Background device-memory watermark sampler.
+
+    Samples ``utils.profiling.device_memory_stats`` every ``interval_s``
+    on a daemon thread and exports per-device gauges through the metrics
+    registry: ``profile_memory_bytes_in_use`` (live),
+    ``profile_memory_peak_bytes`` (the backend's own peak counter, when it
+    reports one) and ``profile_memory_watermark_bytes`` (the max in-use
+    THIS sampler observed — survives a backend whose peak counter resets).
+    ``profile_memory_samples_total`` counts sampler ticks, so "the sampler
+    ran but this backend reports nothing" (CPU) is distinguishable from
+    "the sampler never ran". Use as a context manager around a training
+    window, or ``start()``/``stop()`` explicitly."""
+
+    def __init__(self, registry=None, interval_s: float = 0.5):
+        self.interval_s = float(interval_s)
+        if registry is None:
+            from deeplearning4j_tpu.telemetry.registry import default_registry
+
+            registry = default_registry()
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._watermarks: Dict[str, int] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.samples = 0
+
+    def sample_once(self) -> List[Dict]:
+        """One sampling pass; returns the raw per-device stats list."""
+        from deeplearning4j_tpu.utils.profiling import device_memory_stats
+
+        stats = device_memory_stats()
+        with self._lock:
+            self.samples += 1
+            for entry in stats:
+                dev = entry.get("device", "?")
+                in_use = entry.get("bytes_in_use")
+                if in_use is None:
+                    continue
+                labels = {"device": dev}
+                self._registry.gauge("profile_memory_bytes_in_use",
+                                     labels).set(in_use)
+                peak = entry.get("peak_bytes_in_use")
+                if peak is not None:
+                    self._registry.gauge("profile_memory_peak_bytes",
+                                         labels).set(peak)
+                wm = max(self._watermarks.get(dev, 0), int(in_use))
+                self._watermarks[dev] = wm
+                self._registry.gauge("profile_memory_watermark_bytes",
+                                     labels).set(wm)
+        self._registry.counter("profile_memory_samples_total").inc()
+        return stats
+
+    def watermarks(self) -> Dict[str, int]:
+        """device → max bytes_in_use observed (empty on CPU backends)."""
+        with self._lock:
+            return dict(self._watermarks)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.sample_once()
+            except Exception:
+                # a flaky backend stat must never kill the sampler thread;
+                # the samples counter exposes the stall
+                pass
+
+    def start(self) -> "MemoryWatermarkSampler":
+        if self._thread is None:
+            self._stop.clear()
+            self.sample_once()  # immediate first sample, not interval-late
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> Dict[str, int]:
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=10)
+            self._thread = None
+            self.sample_once()  # closing sample catches the final state
+        return self.watermarks()
+
+    def __enter__(self) -> "MemoryWatermarkSampler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -30,6 +30,7 @@ INDEX_HTML = """<!doctype html>
 <li><a href="/api/telemetry">telemetry snapshot (JSON)</a></li>
 <li><a href="/api/memory">device memory stats</a></li>
 <li><a href="/api/trace">live trace spans (open + recent)</a></li>
+<li><a href="/api/profile">compiled-step profiles (cost/memory/collectives)</a></li>
 </ul>
 <h2>api</h2>
 <ul>
@@ -66,6 +67,7 @@ class UiServer:
         self.port: Optional[int] = None
         self._metrics_registry = None
         self._tracer = None
+        self._profile_store = None
 
     # ---- telemetry (ISSUE 2: Prometheus + JSON export on the UI port) ----
     def attach_metrics(self, registry) -> None:
@@ -83,6 +85,15 @@ class UiServer:
         round shows the round/barrier spans still open. Falls back to the
         process tracer when none is attached explicitly."""
         self._tracer = tracer
+
+    # ---- profiling (ISSUE 9: live StepProfile view on the UI port) ----
+    def attach_profiles(self, store) -> None:
+        """Serve a telemetry.xprofile.ProfileStore at ``/api/profile``
+        (one record per profiled-step label: XLA cost/memory analysis +
+        the HLO collective inventory). Read at request time; falls back
+        to the process default store when none is attached — a train step
+        built with ``profile=True`` is visible with zero extra wiring."""
+        self._profile_store = store
 
     # ---- uploads (ref ApiResource: the reference POSTs these; in-process
     # registration serves the same purpose without copying through HTTP) ----
@@ -188,6 +199,22 @@ class UiServer:
                                    400)
                         return
                     self._json(tracer.snapshot(limit=limit))
+                elif url.path == "/api/profile":
+                    from deeplearning4j_tpu.telemetry.xprofile import (
+                        default_profile_store,
+                    )
+
+                    store = ui._profile_store or default_profile_store()
+                    label = q.get("label", [None])[0]
+                    if label is not None:
+                        rec = store.get(label)
+                        if rec is None:
+                            self._json({"error": f"no profile for label "
+                                        f"{label!r}"}, 404)
+                            return
+                        self._json(rec)
+                        return
+                    self._json({"profiles": store.snapshot()})
                 elif url.path == "/api/words":
                     self._json({"count": len(ui._words), "words": ui._words[:200]})
                 elif url.path == "/api/nearest":

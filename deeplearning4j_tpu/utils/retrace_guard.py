@@ -22,14 +22,25 @@ Usage (context manager)::
 tests/conftest.py exposes the same object as the ``retrace_budget``
 pytest fixture; tests/test_retrace_guard.py pins compile budgets for the
 composed LM / pipeline / DP-sync steps.
+
+ISSUE 9: the guard also records the ABSTRACT SIGNATURE of each compile —
+the ``Compiling <fn> with global shapes and types [ShapedArray(...)]``
+line jax's pjit lowering logs carries exactly the shapes/dtypes/weak-types
+that keyed the cache miss. A logging filter on that logger captures the
+signatures into a bounded ring (and swallows the log record, so there is
+no stderr spam), and a blown budget now reports *what* recompiled plus
+the positional signature diff vs the previous compile of the same
+function — "arg 2: f32[8] → weak f32[]" instead of just a count.
 """
 
 from __future__ import annotations
 
 import logging
+import re
 import threading
 
-__all__ = ["RetraceBudgetExceeded", "retrace_guard", "compiles_so_far"]
+__all__ = ["RetraceBudgetExceeded", "retrace_guard", "compiles_so_far",
+           "recent_compiles", "signature_diff"]
 
 
 class RetraceBudgetExceeded(AssertionError):
@@ -67,6 +78,65 @@ class _LogCompilesHandler(logging.Handler):
                 _counter["n"] += 1
 
 
+# ------------------------------------------------- compile signatures ----
+
+# pjit's per-compile log line (fires at DEBUG even with jax_log_compiles
+# off, so capturing it costs no stderr noise)
+_COMPILING_RE = re.compile(
+    r"Compiling ([^\s]+) with global shapes and types \[(.*)\]\."
+)
+_PXLA_LOGGER = "jax._src.interpreters.pxla"
+_SIG_RING_MAX = 64
+_sig_ring: list = []  # [{"seq", "name", "signature"}], bounded
+_sig_seq = {"n": 0}
+
+
+class _CompileSignatureFilter(logging.Filter):
+    """Records each compile's (fn name, abstract signature) into the ring.
+
+    Returns False for the matched records when the compile COUNTER does
+    not depend on them (duration/event modes) — captured, not printed;
+    in the last-resort 'log' counter mode the record must keep flowing to
+    the counting handler, so it passes through."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        m = _COMPILING_RE.search(record.getMessage())
+        if not m:
+            return True
+        with _lock:
+            _sig_seq["n"] += 1
+            _sig_ring.append({"seq": _sig_seq["n"], "name": m.group(1),
+                              "signature": m.group(2)})
+            del _sig_ring[:-_SIG_RING_MAX]
+            suppress = _installed["mode"] != "log"
+        return not suppress
+
+
+def recent_compiles(since_seq: int = 0) -> list:
+    """Compile records (seq, fn name, abstract signature) captured after
+    ``since_seq`` — best-effort forensics riding the pjit log line; the
+    compile COUNT always comes from jax.monitoring."""
+    _install()
+    with _lock:
+        return [dict(r) for r in _sig_ring if r["seq"] > since_seq]
+
+
+def _sig_avals(signature: str) -> list:
+    return re.findall(r"ShapedArray\([^()]*\)", signature)
+
+
+def signature_diff(prev: str, cur: str) -> str:
+    """Human-readable positional diff of two abstract signatures."""
+    a, b = _sig_avals(prev), _sig_avals(cur)
+    if not a and not b:
+        return "signatures unparsed"
+    if len(a) != len(b):
+        return f"arg count changed: {len(a)} -> {len(b)}"
+    changes = [f"arg {i}: {x} -> {y}"
+               for i, (x, y) in enumerate(zip(a, b)) if x != y]
+    return "; ".join(changes) if changes else "signatures identical"
+
+
 def _install() -> str:
     """Register the process-wide compile listener once; returns the mode
     actually installed ('duration' | 'event' | 'log')."""
@@ -90,6 +160,12 @@ def _install() -> str:
                             "jax._src.interpreters.pxla"):
             logging.getLogger(logger_name).addHandler(handler)
         mode = "log"
+    # signature recorder (ISSUE 9): pjit logs its per-compile abstract
+    # signature at DEBUG; enable that level on just this logger and let
+    # the filter capture (and, outside 'log' mode, swallow) the records
+    pxla_logger = logging.getLogger(_PXLA_LOGGER)
+    pxla_logger.setLevel(logging.DEBUG)
+    pxla_logger.addFilter(_CompileSignatureFilter())
     with _lock:
         _installed["mode"] = mode
     return mode
@@ -118,15 +194,38 @@ class retrace_guard:
         self.budget = int(budget)
         self.label = label
         self.count = 0
+        self.compiled: list = []  # signature records seen inside the region
         self._start = 0
+        self._sig_start = 0
 
     def __enter__(self) -> "retrace_guard":
         _install()
         self._start = compiles_so_far()
+        with _lock:
+            self._sig_start = _sig_seq["n"]
         return self
+
+    def _signature_report(self) -> str:
+        """What recompiled in this region + the diff vs each program's
+        previous compile (ISSUE 9) — empty when the pjit log line was not
+        observed (ancient jaxlib, non-pjit compile paths)."""
+        if not self.compiled:
+            return ""
+        lines = ["", "compiled in this region:"]
+        with _lock:
+            ring = [dict(r) for r in _sig_ring]
+        for rec in self.compiled:
+            lines.append(f"  {rec['name']} [{rec['signature']}]")
+            prev = [r for r in ring
+                    if r["name"] == rec["name"] and r["seq"] < rec["seq"]]
+            if prev:
+                lines.append("    vs previous compile: " + signature_diff(
+                    prev[-1]["signature"], rec["signature"]))
+        return "\n".join(lines)
 
     def __exit__(self, exc_type, exc, tb) -> bool:
         self.count = compiles_so_far() - self._start
+        self.compiled = recent_compiles(self._sig_start)
         if exc_type is None and self.count > self.budget:
             what = f" [{self.label}]" if self.label else ""
             raise RetraceBudgetExceeded(
@@ -136,7 +235,7 @@ class retrace_guard:
                 "call (python scalar vs array argument, changing batch "
                 "shape, donation layout flip). Pin the input shapes/dtypes "
                 "— or raise the budget deliberately if the new compiles "
-                "are intended.")
+                "are intended." + self._signature_report())
         return False
 
 

@@ -90,9 +90,17 @@ def _child_main(n: int, batch: int, mode: str, warmup: int = WARMUP,
     w = jnp.ones((batch,), jnp.float32)
     key = jax.random.PRNGKey(1)
 
-    lowered = step.lower(params, states, jnp.asarray(0), x, y, w, key)
-    hlo = lowered.compile().as_text()
-    n_allreduce = hlo.count("all-reduce-start") or hlo.count(" all-reduce(")
+    # collective accounting via the shared compiled-step profiler (ISSUE 9;
+    # replaces the ad-hoc as_text() scrape): one AOT compile, the inventory
+    # counts sync AND async (-start) all-reduces with their analytic wire
+    # bytes under the documented ring convention
+    from deeplearning4j_tpu.telemetry.xprofile import profile_lowered
+
+    prof = profile_lowered(
+        step.lower(params, states, jnp.asarray(0), x, y, w, key),
+        label=f"dp_sync[{n}]")
+    allreduce = prof.collectives.get("all-reduce", {})
+    n_allreduce = allreduce.get("count", 0)
     param_bytes = sum(int(jnp.size(leaf)) * 4 for layer in params
                       for leaf in jax.tree_util.tree_leaves(layer))
 
@@ -123,6 +131,8 @@ def _child_main(n: int, batch: int, mode: str, warmup: int = WARMUP,
         "ms_median": statistics.median(reps) / steps * 1000.0,
         "ms_repeats": [r / steps * 1000.0 for r in reps],
         "all_reduce_ops": n_allreduce,
+        "all_reduce_wire_bytes": allreduce.get("wire_bytes", 0.0),
+        "xla_flops": prof.flops,
         "param_bytes": param_bytes,
     }), flush=True)
 

@@ -157,7 +157,12 @@ def test_block_partials_merge_matches_dense(causal):
 
 
 def _train_temp_bytes(t, impl):
-    """Compiled temp allocation of a value_and_grad step at length t."""
+    """Compiled temp allocation of a value_and_grad step at length t, via
+    the shared compiled-step profiler (ISSUE 9 — the one-off
+    memory_analysis() call this helper used to make, now through
+    telemetry/xprofile.py so every introspection site shares one parser)."""
+    from deeplearning4j_tpu.telemetry.xprofile import profile_compiled
+
     b, h, d = 1, 2, 64
     q, k, v = _qkv(b=b, h=h, t=t, d=d)
 
@@ -169,8 +174,11 @@ def _train_temp_bytes(t, impl):
         return jnp.sum(o ** 2)
 
     f = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
-    mem = f.lower(q, k, v).compile().memory_analysis()
-    return int(mem.temp_size_in_bytes)
+    prof = profile_compiled(f, q, k, v, label=f"attn_{impl}_t{t}")
+    assert prof.temp_bytes is not None, (
+        "CPU memory_analysis went missing — the O(T) linearity check "
+        "needs temp bytes")
+    return int(prof.temp_bytes)
 
 
 def test_blockwise_memory_is_linear_in_t():

@@ -75,6 +75,86 @@ def test_listener_in_real_training(tmp_path):
     assert _dir_has_files(log_dir)
 
 
+class _TraceSpy:
+    """Records jax.profiler start/stop calls without arming the real XLA
+    profiler (a still-armed profiler would poison later tests — exactly
+    the failure mode close() exists to prevent)."""
+
+    def __init__(self, monkeypatch):
+        self.events = []
+        monkeypatch.setattr(
+            jax.profiler, "start_trace",
+            lambda log_dir, **kw: self.events.append(("start", log_dir)))
+        monkeypatch.setattr(
+            jax.profiler, "stop_trace",
+            lambda: self.events.append(("stop", None)))
+
+    @property
+    def starts(self):
+        return [e for e in self.events if e[0] == "start"]
+
+    @property
+    def stops(self):
+        return [e for e in self.events if e[0] == "stop"]
+
+
+class TestProfilerListenerWindowSemantics:
+    """ISSUE 9 satellite: the exact window contract — listeners fire
+    AFTER each iteration, so a window opened at the ``start``-th callback
+    traces callbacks start+1 … start+steps — plus close() releasing a
+    still-open trace, idempotently."""
+
+    def test_window_opens_at_start_and_spans_steps(self, monkeypatch,
+                                                   tmp_path):
+        spy = _TraceSpy(monkeypatch)
+        listener = ProfilerIterationListener(str(tmp_path), start=2, steps=3)
+        opened_at, closed_at = None, None
+        for i in range(8):
+            listener(None, i, 0.0)
+            if spy.starts and opened_at is None:
+                opened_at = i
+            if spy.stops and closed_at is None:
+                closed_at = i
+        # start=2: the trace opens once the 2nd callback has fired...
+        assert opened_at == 1  # 2nd callback = loop index 1
+        # ...and spans the NEXT 3 iterations (callbacks 3, 4, 5)
+        assert closed_at == 4  # 5th callback = loop index 4
+        assert len(spy.starts) == 1 and len(spy.stops) == 1
+        # the window is one-shot: later iterations never rearm it
+        listener(None, 99, 0.0)
+        assert len(spy.starts) == 1
+
+    def test_start_zero_opens_at_first_callback(self, monkeypatch,
+                                                tmp_path):
+        spy = _TraceSpy(monkeypatch)
+        listener = ProfilerIterationListener(str(tmp_path), start=0, steps=1)
+        listener(None, 0, 0.0)
+        assert len(spy.starts) == 1
+        listener(None, 1, 0.0)
+        assert len(spy.stops) == 1
+
+    def test_close_releases_still_open_trace(self, monkeypatch, tmp_path):
+        spy = _TraceSpy(monkeypatch)
+        listener = ProfilerIterationListener(str(tmp_path), start=1, steps=5)
+        for i in range(2):  # training ends INSIDE the window
+            listener(None, i, 0.0)
+        assert len(spy.starts) == 1 and len(spy.stops) == 0
+        listener.close()
+        assert len(spy.stops) == 1
+        # idempotent: a second close (finally-block double call) is a no-op
+        listener.close()
+        assert len(spy.stops) == 1
+        # and the closed listener never reopens a window
+        listener(None, 5, 0.0)
+        assert len(spy.starts) == 1
+
+    def test_close_before_window_opens_is_noop(self, monkeypatch, tmp_path):
+        spy = _TraceSpy(monkeypatch)
+        listener = ProfilerIterationListener(str(tmp_path), start=5, steps=2)
+        listener.close()  # nothing armed yet
+        assert spy.events == []
+
+
 def test_cli_train_profile_flag(tmp_path):
     """--profile DIR on the train subcommand captures a trace around fit."""
     from deeplearning4j_tpu.cli.driver import main

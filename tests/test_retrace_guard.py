@@ -14,7 +14,9 @@ from jax.sharding import Mesh
 from deeplearning4j_tpu.utils.retrace_guard import (
     RetraceBudgetExceeded,
     compiles_so_far,
+    recent_compiles,
     retrace_guard,
+    signature_diff,
 )
 
 V, D, H, E, DFF = 32, 16, 2, 2, 32
@@ -52,6 +54,53 @@ def test_weak_type_drift_is_caught():
     with pytest.raises(RetraceBudgetExceeded):
         with retrace_guard(0, label="weak-type drift"):
             f(x, 2.0)  # python float -> weak type -> retrace
+
+
+def test_blown_budget_reports_what_recompiled():
+    """ISSUE 9: the error names the recompiled program with its abstract
+    signature AND diffs it against the program's previous compile —
+    'arg 1 went weak' instead of a bare count."""
+
+    def distinctly_named_step(x, s):
+        return x * s
+
+    f = jax.jit(distinctly_named_step)
+    x = jnp.ones((7,))
+    compiles_so_far()  # ensure the signature recorder is installed
+    f(x, jnp.float32(2.0))  # warm: strong-typed signature recorded
+    with pytest.raises(RetraceBudgetExceeded) as ei:
+        with retrace_guard(0, label="forensics"):
+            f(x, 2.0)  # weak-type drift
+    msg = str(ei.value)
+    assert "compiled in this region:" in msg
+    assert "distinctly_named_step" in msg
+    assert "weak_type=True" in msg
+    # the diff vs the warm compile pinpoints the drifted argument
+    assert "vs previous compile:" in msg
+    assert "arg 1:" in msg and "->" in msg
+
+
+def test_guard_records_signatures_even_under_budget():
+    """Signatures are forensics, not failures: a region whose compiles
+    fit the budget still exposes them on guard.compiled."""
+    f = jax.jit(lambda x: x + 3)
+    compiles_so_far()  # install the recorder before the compile
+    with retrace_guard(2, label="cold region") as guard:
+        f(jnp.ones((9,)))
+    assert guard.count >= 1
+    assert any("float32[9]" in rec["signature"] for rec in guard.compiled)
+    assert recent_compiles()  # process-wide ring retains them
+
+
+def test_signature_diff_is_positional():
+    a = "ShapedArray(float32[4]), ShapedArray(float32[])"
+    b = "ShapedArray(float32[4]), ShapedArray(float32[], weak_type=True)"
+    d = signature_diff(a, b)
+    assert d == ("arg 1: ShapedArray(float32[]) -> "
+                 "ShapedArray(float32[], weak_type=True)")
+    assert signature_diff(a, a) == "signatures identical"
+    assert "arg count changed: 2 -> 1" == signature_diff(
+        a, "ShapedArray(float32[4])")
 
 
 def test_lm_composed_single_device_budget(retrace_budget):

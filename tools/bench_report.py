@@ -19,6 +19,14 @@ ratio keys), one column per round, plus the delta of the latest value vs
 the previous round that has one. Deltas below ``-threshold`` (default
 10%) are flagged as regressions; ``--fail-on-regression`` turns them into
 exit code 1 for CI use. ``--json`` emits the raw structure.
+
+ISSUE 9: rounds whose stage details embed compiled-step profile blobs
+(telemetry/xprofile.py StepProfile dicts under ``<stage>_detail.profile``)
+contribute ``<stage>_profile_peak_bytes`` / ``<stage>_profile_collective_
+bytes`` / ``<stage>_profile_flops`` rows. Peak-memory and collective-byte
+rows are LOWER-IS-BETTER: ``--fail-on-regression`` also trips when one of
+them GROWS past the threshold — a PR fattening the compiled step's
+footprint fails the gate before it ever runs on a chip.
 """
 
 from __future__ import annotations
@@ -37,6 +45,9 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _METRIC_RE = re.compile(
     r"_(?:per_sec|per_chip|mfu|vs_cpu|vs_single|vs_densecore|vs_baseline|"
     r"blocking_vs_background|overhead_pct)$")
+# profile-blob metrics where an INCREASE is the regression (ISSUE 9)
+_LOWER_IS_BETTER_RE = re.compile(
+    r"_profile_(?:peak_bytes|collective_bytes)$")
 # recovery regex for a truncated tail: top-level "key": number pairs
 _TAIL_PAIR_RE = re.compile(
     r'"([a-z0-9_]+)":\s*(-?[0-9]+(?:\.[0-9]+)?(?:[eE][-+]?[0-9]+)?)')
@@ -52,6 +63,29 @@ def _recover_from_tail(tail: str) -> Dict[str, float]:
     for key, val in _TAIL_PAIR_RE.findall(tail or ""):
         if _is_metric_key(key):
             out[key] = float(val)  # last occurrence wins (closest to end)
+    return out
+
+
+def _profile_metrics(detail: Dict) -> Dict[str, float]:
+    """Trackable numbers from the StepProfile blobs stage details embed:
+    ``<stage>_detail.profile`` → ``<stage>_profile_{peak_bytes,
+    collective_bytes,flops}`` (absent blobs contribute nothing — an old
+    round must never read as 'footprint went to zero')."""
+    out: Dict[str, float] = {}
+    for key, val in detail.items():
+        if not key.endswith("_detail") or not isinstance(val, dict):
+            continue
+        prof = val.get("profile")
+        if not isinstance(prof, dict):
+            continue
+        stage = key[: -len("_detail")]
+        for metric, src in (("profile_peak_bytes", "peak_bytes"),
+                            ("profile_collective_bytes",
+                             "collective_wire_bytes"),
+                            ("profile_flops", "flops")):
+            v = prof.get(src)
+            if isinstance(v, (int, float)):
+                out[f"{stage}_{metric}"] = float(v)
     return out
 
 
@@ -75,6 +109,7 @@ def load_rounds(bench_dir: str) -> List[Dict]:
             detail = parsed.get("detail") or {}
             metrics = {k: float(v) for k, v in detail.items()
                        if _is_metric_key(k) and isinstance(v, (int, float))}
+            metrics.update(_profile_metrics(detail))
             rounds.append({"round": int(m.group(1)), "source": "parsed",
                            "metrics": metrics,
                            "headline": parsed.get("value")})
@@ -101,11 +136,15 @@ def build_trajectory(rounds: List[Dict], threshold_pct: float = 10.0
             (prev_n, prev), (last_n, last) = present[-2], present[-1]
             if prev:
                 delta_pct = round((last - prev) / abs(prev) * 100.0, 2)
+        lower_better = bool(_LOWER_IS_BETTER_RE.search(key))
+        regressed = (delta_pct is not None
+                     and (delta_pct > threshold_pct if lower_better
+                          else delta_pct < -threshold_pct))
         row = {"metric": key, "series": series, "delta_pct": delta_pct,
-               "regression": (delta_pct is not None
-                              and delta_pct < -threshold_pct)}
+               "lower_is_better": lower_better, "regression": regressed}
         if row["regression"]:
             regressions.append({"metric": key, "delta_pct": delta_pct,
+                                "lower_is_better": lower_better,
                                 "from_round": present[-2][0],
                                 "to_round": present[-1][0]})
         table.append(row)
@@ -143,10 +182,12 @@ def render_text(traj: Dict) -> str:
         flag = "REGRESSION" if row["regression"] else ""
         lines.append(f"{row['metric']:<{width}}  {cells}  {delta}  {flag}")
     if traj["regressions"]:
-        lines += ["", f"{len(traj['regressions'])} regression(s) worse than "
-                  f"-{traj['threshold_pct']}% vs previous round:"]
+        lines += ["", f"{len(traj['regressions'])} regression(s) past "
+                  f"±{traj['threshold_pct']}% vs previous round:"]
         lines += [f"  {r['metric']}: {r['delta_pct']}% "
                   f"(r{r['from_round']} -> r{r['to_round']})"
+                  + (" [lower is better — footprint grew]"
+                     if r.get("lower_is_better") else "")
                   for r in traj["regressions"]]
     return "\n".join(lines)
 

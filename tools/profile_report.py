@@ -1,0 +1,243 @@
+#!/usr/bin/env python
+"""Render the compiled-step profile blobs bench rounds embed into a
+per-stage roofline/attribution report (ISSUE 9).
+
+Usage:
+    python tools/profile_report.py [--dir REPO] [--json] [--round N]
+
+Data source: the ``BENCH_r*.json`` driver artifacts (same files
+tools/bench_report.py reads). Since ISSUE 9 the ``lm_composed`` stage and
+the dedicated ``profile`` stage embed a ``profile`` blob in their stage
+detail — the :class:`~deeplearning4j_tpu.telemetry.xprofile.StepProfile`
+dict (XLA cost/memory analysis + HLO collective inventory) plus the
+analytic-vs-XLA FLOPs cross-check and the measured-MFU attribution. This
+tool renders, for the selected round (default: latest with blobs):
+
+- a per-stage **roofline table**: XLA FLOPs, bytes accessed, arithmetic
+  intensity, peak/temp bytes, collective wire bytes, donated args,
+  compile seconds, and the attribution block when the stage embedded one
+  (measured MFU, HBM utilization, comm fraction, bound);
+- the **analytic-vs-XLA FLOPs cross-check** per stage (the hand-table
+  honesty signal — tier-1 pins the same ratio at test shapes);
+- **cross-round deltas** of FLOPs / peak bytes / collective wire bytes
+  per stage — the cheap way to see a PR quietly fattening the compiled
+  step before it ever runs on a chip.
+
+Exit code 0 with "no profile blobs" when the rounds predate ISSUE 9 —
+missing data is reported, never invented.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_DETAIL_KEY_RE = re.compile(r"^(.*)_detail$")
+
+
+def load_profile_rounds(bench_dir: str) -> List[Dict]:
+    """[{round, stages: {stage: {profile, attribution?, crosscheck?}}}]
+    for every BENCH_r*.json whose parsed detail embeds profile blobs."""
+    rounds = []
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_r*.json"))):
+        m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as fh:
+                rec = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        parsed = rec.get("parsed")
+        if not isinstance(parsed, dict):
+            continue
+        detail = parsed.get("detail") or {}
+        stages: Dict[str, Dict] = {}
+        for key, val in detail.items():
+            dm = _DETAIL_KEY_RE.match(key)
+            if not dm or not isinstance(val, dict):
+                continue
+            prof = val.get("profile")
+            if not isinstance(prof, dict):
+                continue
+            stages[dm.group(1)] = {
+                "profile": prof,
+                "attribution": (val.get("profile_attribution")
+                                or val.get("attribution")),
+                "xla_vs_analytic": (prof.get("xla_vs_analytic_flops")
+                                    or val.get("xla_vs_analytic_flops")),
+                "analytic_flops": (prof.get("analytic_train_flops")
+                                   or val.get("analytic_train_flops")),
+            }
+        if stages:
+            rounds.append({"round": int(m.group(1)), "stages": stages})
+    rounds.sort(key=lambda r: r["round"])
+    return rounds
+
+
+def _fmt_bytes(n: Optional[float]) -> str:
+    if n is None:
+        return "-"
+    for unit, div in (("GB", 1e9), ("MB", 1e6), ("kB", 1e3)):
+        if abs(n) >= div:
+            return f"{n / div:.1f}{unit}"
+    return f"{n:.0f}B"
+
+
+def _fmt_flops(n: Optional[float]) -> str:
+    if n is None:
+        return "-"
+    for unit, div in (("TF", 1e12), ("GF", 1e9), ("MF", 1e6), ("kF", 1e3)):
+        if abs(n) >= div:
+            return f"{n / div:.2f}{unit}"
+    return f"{n:.0f}F"
+
+
+def build_report(rounds: List[Dict],
+                 round_id: Optional[int] = None) -> Dict:
+    """The selected round's roofline rows + cross-round deltas."""
+    if not rounds:
+        return {"rounds": [], "selected": None, "stages": [], "deltas": []}
+    sel = rounds[-1]
+    if round_id is not None:
+        matches = [r for r in rounds if r["round"] == round_id]
+        if not matches:
+            raise ValueError(
+                f"round {round_id} has no profile blobs; rounds with "
+                f"blobs: {[r['round'] for r in rounds]}")
+        sel = matches[0]
+
+    stages = []
+    for stage in sorted(sel["stages"]):
+        entry = sel["stages"][stage]
+        prof = entry["profile"]
+        flops = prof.get("flops")
+        bytes_acc = prof.get("bytes_accessed")
+        collectives = prof.get("collectives") or {}
+        stages.append({
+            "stage": stage,
+            "platform": prof.get("platform"),
+            "flops": flops,
+            "bytes_accessed": bytes_acc,
+            "arithmetic_intensity": (round(flops / bytes_acc, 2)
+                                     if flops and bytes_acc else None),
+            "peak_bytes": prof.get("peak_bytes"),
+            "temp_bytes": prof.get("temp_bytes"),
+            "collective_wire_bytes": prof.get("collective_wire_bytes"),
+            "collective_counts": {k: v.get("count")
+                                  for k, v in collectives.items()},
+            "donated_args": prof.get("donated_args"),
+            "compile_seconds": prof.get("compile_seconds"),
+            "xla_vs_analytic_flops": entry["xla_vs_analytic"],
+            "attribution": entry["attribution"],
+        })
+
+    tracked = ("flops", "peak_bytes", "collective_wire_bytes")
+    deltas = []
+    for stage in sorted(sel["stages"]):
+        series = [(r["round"], r["stages"][stage]["profile"])
+                  for r in rounds if stage in r["stages"]]
+        if len(series) < 2:
+            continue
+        (prev_n, prev), (last_n, last) = series[-2], series[-1]
+        row = {"stage": stage, "from_round": prev_n, "to_round": last_n}
+        for key in tracked:
+            a, b = prev.get(key), last.get(key)
+            row[key] = {
+                "prev": a, "last": b,
+                "delta_pct": (round((b - a) / abs(a) * 100.0, 2)
+                              if a and b is not None else None),
+            }
+        deltas.append(row)
+    return {
+        "rounds": [r["round"] for r in rounds],
+        "selected": sel["round"],
+        "stages": stages,
+        "deltas": deltas,
+    }
+
+
+def render_text(report: Dict) -> str:
+    if not report["stages"]:
+        return ("no profile blobs found in any BENCH_r*.json — rounds "
+                "predate ISSUE 9 or the bench has not run since")
+    lines = [f"compiled-step profiles — round r{report['selected']:02d} "
+             f"(rounds with blobs: "
+             + ", ".join(f"r{n}" for n in report["rounds"]) + ")", ""]
+    lines.append(f"{'stage':<14} {'flops':>10} {'bytes':>9} {'AI':>7} "
+                 f"{'peak':>9} {'wire':>9}  collectives")
+    for row in report["stages"]:
+        colls = ", ".join(f"{k}x{v}" for k, v in
+                          sorted(row["collective_counts"].items())) or "-"
+        ai = (f"{row['arithmetic_intensity']:.1f}"
+              if row["arithmetic_intensity"] is not None else "-")
+        lines.append(
+            f"{row['stage']:<14} {_fmt_flops(row['flops']):>10} "
+            f"{_fmt_bytes(row['bytes_accessed']):>9} {ai:>7} "
+            f"{_fmt_bytes(row['peak_bytes']):>9} "
+            f"{_fmt_bytes(row['collective_wire_bytes']):>9}  {colls}")
+    lines.append("")
+    for row in report["stages"]:
+        att = row["attribution"]
+        xc = row["xla_vs_analytic_flops"]
+        bits = []
+        if xc is not None:
+            bits.append(f"xla/analytic flops {xc:.3f}")
+        if att:
+            if att.get("measured_mfu") is not None:
+                bits.append(f"measured MFU {att['measured_mfu']:.4f}")
+            if att.get("hbm_utilization") is not None:
+                bits.append(f"HBM util {att['hbm_utilization']:.4f}")
+            if att.get("comm_fraction") is not None:
+                bits.append(f"comm frac {att['comm_fraction']:.4f}")
+            if att.get("bound"):
+                bits.append(f"{att['bound']}-bound")
+        if bits:
+            lines.append(f"  {row['stage']}: " + ", ".join(bits))
+    if report["deltas"]:
+        lines += ["", "cross-round deltas (prev -> last):"]
+        for row in report["deltas"]:
+            for key in ("flops", "peak_bytes", "collective_wire_bytes"):
+                d = row[key]
+                if d["delta_pct"] is None:
+                    continue
+                flag = "  <-- GREW" if d["delta_pct"] > 10.0 else ""
+                fmt = _fmt_flops if key == "flops" else _fmt_bytes
+                lines.append(
+                    f"  {row['stage']} {key}: {fmt(d['prev'])} -> "
+                    f"{fmt(d['last'])} ({d['delta_pct']:+.1f}% "
+                    f"r{row['from_round']}->r{row['to_round']}){flag}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=REPO_ROOT,
+                    help="directory holding BENCH_r*.json (default: repo)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON")
+    ap.add_argument("--round", type=int, default=None,
+                    help="render this round's blobs (default: latest)")
+    args = ap.parse_args(argv)
+    rounds = load_profile_rounds(args.dir)
+    try:
+        report = build_report(rounds, round_id=args.round)
+    except ValueError as exc:
+        print(str(exc), file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(render_text(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
