@@ -1419,6 +1419,145 @@ def measure_elastic_trace() -> float:
     return overhead_pct
 
 
+def measure_serve() -> float:
+    """ISSUE 10 serving bench: the continuous-batching decode engine
+    (deeplearning4j_tpu/serve/) under the synthetic open-loop traffic
+    generator vs the naive recompute-per-token baseline that ``cli
+    predict`` used to be.
+
+    Both sides run the SAME bf16-prepared weights (serve/quant.py), so the
+    headline ratio isolates what the KV cache + iteration-level batching
+    buy, not a dtype change. The naive baseline is the honest fixed-shape
+    version of full-forward generation: one jitted full forward over the
+    padded decode window per token, batch 1, requests served sequentially
+    — O(window) work per token where the decode step does O(1).
+
+    Headline value = engine generated-tokens/sec under the open-loop run;
+    the detail carries exact p50/p95/mean request latency (LOWER-IS-BETTER
+    rows in tools/bench_report.py — latency growth trips
+    ``--fail-on-regression``), the naive baseline rate, the
+    ``serve_vs_naive`` ratio (>1 asserted in test_bench_smoke), occupancy,
+    and the int8 weight-only-quantized A/B twin (tokens/s + at-rest weight
+    bytes vs bf16)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.models.transformer_lm import (
+        init_lm_params,
+        lm_prefill,
+    )
+    from deeplearning4j_tpu.serve import (
+        DecodeEngine,
+        prepare_serve_params,
+        run_open_loop,
+    )
+
+    if _fast():
+        vocab, d, heads, experts, dff, layers = 128, 32, 2, 2, 64, 2
+        slots, max_len, max_new, n_req, rate = 4, 64, 8, 12, 400.0
+        prompt_lo, prompt_hi = 4, 12
+        naive_req = 4
+    else:
+        vocab, d, heads, experts, dff, layers = LMC_VOCAB, 256, 4, 4, 512, 2
+        slots, max_len, max_new, n_req, rate = 8, 256, 32, 32, 50.0
+        prompt_lo, prompt_hi = 16, 48
+        naive_req = 8
+
+    params = init_lm_params(jax.random.PRNGKey(0), vocab, d, heads, experts,
+                            dff, n_layers=layers)
+    rng = np.random.RandomState(3)
+    prompts = [list(rng.randint(0, vocab,
+                                rng.randint(prompt_lo, prompt_hi)))
+               for _ in range(n_req)]
+
+    # ---- naive recompute-per-token baseline (same bf16 weights): the
+    # full-prompt pass re-run per token with the K/V outputs thrown away —
+    # exactly the work a cache-less fixed-shape serving loop does ----
+    bf16_params = prepare_serve_params(params, "bf16")
+
+    def _naive_next(p, toks, pos):
+        logits, _ks, _vs = lm_prefill(p, toks, heads)
+        return jnp.argmax(
+            jax.lax.dynamic_index_in_dim(logits[0], pos, 0, keepdims=False),
+            -1)
+
+    naive_next = jax.jit(_naive_next, donate_argnums=())
+
+    def naive_run(reqs):
+        total = 0
+        t0 = time.perf_counter()
+        for prompt in reqs:
+            toks = np.zeros((1, max_len), np.int32)
+            toks[0, :len(prompt)] = prompt
+            pos = len(prompt) - 1
+            for _ in range(max_new):
+                nxt = int(np.asarray(  # per-token sync IS the baseline
+                    naive_next(bf16_params, jnp.asarray(toks), pos)))
+                pos += 1
+                toks[0, pos] = nxt
+                total += 1
+        return total, time.perf_counter() - t0
+
+    naive_run(prompts[:1])  # compile + warmup
+    naive_total, naive_t = naive_run(prompts[:naive_req])
+    naive_rate = naive_total / naive_t
+
+    # ---- the engine under open-loop load (bf16 headline) ----
+    def warm(eng):
+        # warm every prefill bucket the traffic will hit (a bucket-length
+        # prompt compiles exactly that bucket) + the decode step, outside
+        # the timed run
+        for b in sorted({eng.bucket_for(len(p)) for p in prompts}):
+            eng.generate([1] * min(b, max_len - 1), max_new_tokens=2)
+
+    engine = DecodeEngine(params, heads, n_slots=slots, max_len=max_len,
+                          serve_dtype="bf16")
+    warm(engine)
+    report = run_open_loop(engine, prompts, rate_rps=rate,
+                           max_new_tokens=max_new)
+    stats = engine.stats()
+
+    # ---- int8 weight-only A/B twin ----
+    engine8 = DecodeEngine(params, heads, n_slots=slots, max_len=max_len,
+                           serve_dtype="int8")
+    warm(engine8)
+    report8 = run_open_loop(engine8, prompts[:max(n_req // 2, 2)],
+                            rate_rps=rate, max_new_tokens=max_new)
+
+    detail = {
+        "slots": slots, "max_len": max_len, "n_requests": n_req,
+        "max_new_tokens": max_new, "offered_rps": rate,
+        "serve_dtype": "bf16",
+        "tokens_per_sec": round(report.tokens_per_sec, 1),
+        "latency": {
+            "p50_ms": round(report.latency_p50_ms, 2),
+            "p95_ms": round(report.latency_p95_ms, 2),
+            "mean_ms": round(report.latency_mean_ms, 2),
+            "first_token_p50_ms": (
+                round(report.first_token_p50_ms, 2)
+                if report.first_token_p50_ms is not None else None),
+        },
+        "completed": report.completed,
+        "naive_tokens_per_sec": round(naive_rate, 1),
+        "naive_requests": naive_req,
+        "serve_vs_naive": round(report.tokens_per_sec / naive_rate, 2),
+        "occupancy_mean": round(stats["occupancy_mean"], 2),
+        "decode_steps": stats["decode_steps"],
+        "prefill_buckets": stats["prefill_buckets"],
+        "weight_bytes": stats["weight_bytes"],
+        "int8": {
+            "tokens_per_sec": round(report8.tokens_per_sec, 1),
+            "p50_ms": round(report8.latency_p50_ms, 2),
+            "weight_bytes": engine8.weight_bytes,
+            "weight_bytes_vs_bf16": round(
+                engine8.weight_bytes / max(engine.weight_bytes, 1), 3),
+        },
+    }
+    print("STAGE_DETAIL " + json.dumps(detail), flush=True)
+    return report.tokens_per_sec
+
+
 # ---------------------------------------------------------------------------
 # Stage orchestration. Each stage is `python bench.py --stage NAME`, run by
 # main() in a subprocess with a timeout, so a wedged XLA compile is contained.
@@ -1519,6 +1658,8 @@ def run_stage(name: str) -> float:
         return measure_profile()
     if name == "moe":
         return measure_moe()
+    if name == "serve":
+        return measure_serve()
     if name == "word2vec":
         if _fast():
             return measure_word2vec(n_sentences=100, sent_len=20, vocab=200)
@@ -1615,6 +1756,7 @@ STAGES = [
     ("guardrails", 220),
     ("profile", 220),
     ("moe", 220),
+    ("serve", 240),
     ("cpu_word2vec", 150),
     ("word2vec", 120),
     ("word2vec_sharded", 150),
@@ -1689,7 +1831,7 @@ def main() -> None:
             key = f"{stage}_steps_per_sec"
         elif stage in ("elastic_trace", "guardrails", "profile"):
             key = f"{stage}_overhead_pct"
-        elif stage == "moe":
+        elif stage in ("moe", "serve"):
             key = f"{stage}_tokens_per_sec"
         else:
             key = f"{stage}_samples_per_sec"
@@ -1758,6 +1900,18 @@ def main() -> None:
         "Value is alltoall tokens/s at G=4; the detail blob carries every "
         "(impl, G) config's tokens/s, estimated per-device comm bytes, "
         "capacity, and measured drop fraction."
+    )
+    detail["serve_note"] = (
+        "serve = ISSUE 10 decode engine (deeplearning4j_tpu/serve/): the "
+        "flagship LM generating under a synthetic open-loop (Poisson) "
+        "traffic generator through the KV-cached continuous-batching "
+        "scheduler, bf16 weights. Value is generated tokens/s; the detail "
+        "carries exact p50/p95 request latency (LOWER-IS-BETTER rows in "
+        "bench_report), the naive recompute-per-token baseline at the SAME "
+        "bf16 weights (one full forward over the padded window per token, "
+        "sequential — what cli predict used to do), the serve_vs_naive "
+        "ratio, mean slot occupancy, and the int8 weight-only A/B twin "
+        "(serve_dtype seam, serve/quant.py)."
     )
     detail["word2vec_sharded_note"] = (
         "word2vec_sharded = the toy word2vec stage driven through "

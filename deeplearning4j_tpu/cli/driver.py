@@ -138,7 +138,77 @@ def test(args) -> int:
     return 0
 
 
+def _is_lm_checkpoint_dir(path: str) -> bool:
+    """A directory holding a committed sharded checkpoint (scaleout/ckpt
+    layout) — the serving path's model format; plain ``.npz`` param files
+    keep the classic full-forward predict."""
+    import os
+
+    if not os.path.isdir(path):
+        return False
+    from deeplearning4j_tpu.scaleout.ckpt.reshard import latest_step_dir
+
+    return latest_step_dir(path) is not None
+
+
+def _read_prompts(path: str) -> List[List[int]]:
+    """One prompt per line, token ids separated by spaces or commas."""
+    prompts: List[List[int]] = []
+    with open(path, "r", encoding="utf-8") as f:
+        for ln, line in enumerate(f, 1):
+            line = line.replace(",", " ").strip()
+            if not line:
+                continue
+            try:
+                prompts.append([int(t) for t in line.split()])
+            except ValueError:
+                raise SystemExit(
+                    f"{path}:{ln}: prompts must be integer token ids "
+                    "(space- or comma-separated)")
+    if not prompts:
+        raise SystemExit(f"{path}: no prompts found")
+    return prompts
+
+
+def _predict_lm(args) -> int:
+    """ISSUE 10: LM checkpoints generate through the KV-cached decode
+    engine (continuous batching: every prompt is submitted up front and
+    the scheduler interleaves them through the slots) instead of the
+    recompute-per-token full forward."""
+    from deeplearning4j_tpu.serve.engine import DecodeEngine
+
+    engine = DecodeEngine.from_checkpoint(
+        args.model, n_heads=args.heads, n_slots=args.slots,
+        max_len=args.max_len, serve_dtype=args.serve_dtype,
+        eos_id=args.eos_id, seed=args.seed)
+    prompts = _read_prompts(args.input)
+    reqs = [engine.submit(p, max_new_tokens=args.max_new_tokens,
+                          temperature=args.temperature) for p in prompts]
+    engine.run_until_idle()
+    out = "\n".join(" ".join(str(t) for t in r.generated)
+                    for r in reqs) + "\n"
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as f:
+            f.write(out)
+        if args.verbose:
+            print(f"wrote {len(reqs)} generations to {args.output}")
+    else:
+        sys.stdout.write(out)
+    if args.verbose:
+        stats = engine.stats()
+        print(f"decode engine: {stats['tokens_total']} tokens, "
+              f"{stats['decode_steps']} decode steps, mean occupancy "
+              f"{stats['occupancy_mean']:.2f}/{stats['slots']} slots, "
+              f"serve_dtype={stats['serve_dtype']}")
+    return 0
+
+
 def predict(args) -> int:
+    if _is_lm_checkpoint_dir(args.model):
+        return _predict_lm(args)
+    if not args.conf:
+        raise SystemExit("--conf is required unless --model is a sharded "
+                         "LM checkpoint directory")
     net = _load_model(args.conf, args.model)
     it = _make_iterator(args.input, args.batch, args.labels,
                         args.features, args.label_index)
@@ -163,8 +233,12 @@ def predict(args) -> int:
     return 0
 
 
-def _add_common(p: argparse.ArgumentParser, needs_model_in: bool) -> None:
-    p.add_argument("--conf", required=True, help="model conf JSON path")
+def _add_common(p: argparse.ArgumentParser, needs_model_in: bool,
+                conf_required: bool = True) -> None:
+    p.add_argument("--conf", required=conf_required,
+                   help="model conf JSON path" +
+                        ("" if conf_required else
+                         " (not needed for LM checkpoint dirs)"))
     p.add_argument("--input", required=True, help="input data (csv or svmLight)")
     p.add_argument("--model", required=True,
                    help="params .npz path (%s)" %
@@ -201,10 +275,31 @@ def build_parser() -> argparse.ArgumentParser:
     _add_common(p_test, needs_model_in=True)
     p_test.set_defaults(func=test)
 
-    p_pred = sub.add_parser("predict", help="write class predictions")
-    _add_common(p_pred, needs_model_in=True)
+    p_pred = sub.add_parser(
+        "predict",
+        help="write class predictions; with --model pointing at a sharded "
+             "LM checkpoint dir, generate text through the KV-cached "
+             "decode engine instead")
+    _add_common(p_pred, needs_model_in=True, conf_required=False)
     p_pred.add_argument("--output", default=None,
                         help="predictions file (default: stdout)")
+    lm = p_pred.add_argument_group(
+        "LM generation (when --model is a checkpoint dir; --input is then "
+        "a prompts file: one prompt per line of token ids)")
+    lm.add_argument("--max-new-tokens", type=int, default=32)
+    lm.add_argument("--temperature", type=float, default=0.0,
+                    help="<= 0 = greedy decode")
+    lm.add_argument("--heads", type=int, default=None,
+                    help="n_heads when the checkpoint meta lacks it")
+    lm.add_argument("--slots", type=int, default=4,
+                    help="concurrent decode slots (continuous batching)")
+    lm.add_argument("--max-len", type=int, default=256,
+                    help="KV-cache positions per slot (prompt + generation)")
+    lm.add_argument("--serve-dtype", default="bf16",
+                    choices=["f32", "bf16", "int8"],
+                    help="serving weight precision (serve/quant.py seam)")
+    lm.add_argument("--eos-id", type=int, default=None)
+    lm.add_argument("--seed", type=int, default=0)
     p_pred.set_defaults(func=predict)
     return parser
 

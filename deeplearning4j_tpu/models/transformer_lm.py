@@ -603,6 +603,219 @@ def make_pp_loss(stage_fn, mesh: Mesh, pipe_axis: str,
     return loss
 
 
+# ---------------------------------------------------------------- serving ----
+#
+# ISSUE 10: the decode-mode forward behind deeplearning4j_tpu/serve/. Two
+# entry points share the training model's exact per-position math
+# (_layernorm / projections / dense_moe op-for-op, so prefill logits are
+# BIT-identical to lm_forward's and greedy decode parity against the
+# recompute-per-token oracle is pinned in tests/test_serve.py):
+#
+# - ``lm_prefill``: the full-prompt pass through the attn_impl seam (dense
+#   or blockwise flash — the long-prompt path), additionally returning every
+#   layer's projected K/V so the serving engine can seed a request's cache
+#   row in one dispatch.
+# - ``lm_decode_step``: one token per slot attending over the per-slot KV
+#   cache with a position mask — O(1) work per token instead of the O(t)
+#   full recompute ``cli predict`` used to do.
+#
+# The cache is a fixed-size paged buffer: leaf shape (L, S, H, T_max, Dh)
+# where S is the engine's slot count; slot s's page is overwritten on
+# readmission (eviction costs nothing — the mask hides stale positions).
+# Sampling (greedy vs temperature, selected IN-GRAPH from a per-slot
+# temperature vector so one executable serves both) is fused into the same
+# jitted step as the forward — one dispatch per decode iteration.
+
+def init_kv_cache(n_layers: int, n_slots: int, n_heads: int, head_dim: int,
+                  max_len: int, dtype=jnp.float32) -> dict:
+    """Zeroed paged KV cache for ``n_slots`` concurrent requests:
+    ``{"k","v"}`` leaves of shape (L, S, H, T_max, Dh). Zeros (not garbage)
+    so masked-out positions can never inject non-finite values through the
+    0-weight attention terms."""
+    shape = (n_layers, n_slots, n_heads, max_len, head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _decoder_block_kv(layer_params: dict, h: Array, n_heads: int, attn_core,
+                      top_k: int) -> tuple:
+    """``_decoder_block`` with the dense MoE FFN, additionally returning the
+    layer's projected K/V (B, H, T, Dh) for cache seeding. The op sequence
+    is IDENTICAL to _attn_block + _decoder_block's dense path — prefill
+    logits must stay bit-identical to lm_forward's (pinned in
+    tests/test_serve.py)."""
+    hn = _layernorm(h, layer_params["ln_g"], layer_params["ln_b"])
+    q = _split_heads(hn @ layer_params["wq"], n_heads)
+    k = _split_heads(hn @ layer_params["wk"], n_heads)
+    v = _split_heads(hn @ layer_params["wv"], n_heads)
+    # .astype keeps the scan carry dtype stable under serve_dtype="bf16"
+    # (the dense core's f32 score scale widens its output); identity at f32
+    h = h + (_merge_heads(attn_core(q, k, v))
+             @ layer_params["wo"]).astype(h.dtype)
+    h2 = _layernorm(h, layer_params["ln2_g"], layer_params["ln2_b"])
+    flat = h2.reshape(-1, h2.shape[-1])
+    moe_out = dense_moe(layer_params["router"], layer_params["experts"],
+                        flat, top_k)
+    return h + moe_out.reshape(h.shape).astype(h.dtype), k, v
+
+
+def lm_prefill(params: dict, tokens: Array, n_heads: int, top_k: int = 2,
+               attn_impl: Optional[str] = None) -> tuple:
+    """Prompt pass: tokens (B, T_pad) → (logits (B, T_pad, V), ks, vs) with
+    ks/vs (L, B, H, T_pad, Dh) — every layer's projected K/V, ready to seed
+    cache pages. Attention routes through the core-selection seam exactly
+    like the training paths (``attn_impl`` forces dense/blockwise/flash);
+    causal masking makes right-padding exact: positions >= the real length
+    produce garbage K/V that decode's position mask never reads."""
+    core = lambda q, k, v: attention_core(q, k, v, causal=True,  # noqa: E731
+                                          impl=attn_impl)
+    h = params["embed"][tokens]
+
+    def step(h, layer_params):
+        h, k, v = _decoder_block_kv(layer_params, h, n_heads, core, top_k)
+        return h, (k, v)
+
+    h, (ks, vs) = jax.lax.scan(step, h, params["blocks"])
+    return h @ params["dec_w"] + params["dec_b"], ks, vs
+
+
+def _decode_block(layer_params: dict, h: Array, ck: Array, cv: Array,
+                  positions: Array, n_heads: int, top_k: int) -> tuple:
+    """One decoder block for ONE new token per slot. h: (S, 1, d); ck/cv:
+    (S, H, T_max, Dh). Writes this step's K/V at ``positions`` FIRST, then
+    attends with the mask ``index <= position`` — so the freshly written
+    position is visible and stale cache beyond it never is. The attention
+    math mirrors ring_attention.reference_attention (same score scale,
+    same -1e30 mask, jax.nn.softmax): the masked terms underflow to exact
+    zeros, so the padded reduction is bitwise the oracle's unpadded one."""
+    hn = _layernorm(h, layer_params["ln_g"], layer_params["ln_b"])
+    q = _split_heads(hn @ layer_params["wq"], n_heads)    # (S, H, 1, Dh)
+    k_new = _split_heads(hn @ layer_params["wk"], n_heads)
+    v_new = _split_heads(hn @ layer_params["wv"], n_heads)
+    write = jax.vmap(
+        lambda c, kn, p: jax.lax.dynamic_update_slice_in_dim(
+            c, kn.astype(c.dtype), p, axis=1))
+    ck = write(ck, k_new, positions)
+    cv = write(cv, v_new, positions)
+    scores = jnp.einsum("shqd,shkd->shqk", q, ck) / jnp.sqrt(
+        q.shape[-1] * 1.0)                                # (S, H, 1, T_max)
+    mask = (jnp.arange(ck.shape[2])[None, None, None, :]
+            <= positions[:, None, None, None])
+    scores = jnp.where(mask, scores, -1e30)
+    o = jnp.einsum("shqk,shkd->shqd", jax.nn.softmax(scores, -1), cv)
+    # f32 score math, carry-dtype residual (identity at f32 — parity-safe)
+    h = h + (_merge_heads(o) @ layer_params["wo"]).astype(h.dtype)
+    h2 = _layernorm(h, layer_params["ln2_g"], layer_params["ln2_b"])
+    flat = h2.reshape(-1, h2.shape[-1])                   # (S, d)
+    moe_out = dense_moe(layer_params["router"], layer_params["experts"],
+                        flat, top_k)
+    return h + moe_out.reshape(h.shape).astype(h.dtype), ck, cv
+
+
+def lm_decode_step(params: dict, cache: dict, tokens: Array,
+                   positions: Array, n_heads: int, top_k: int = 2) -> tuple:
+    """One decode iteration over every slot: tokens (S,) int32 land at
+    ``positions`` (S,) in the cache and next-token logits (S, V) come back
+    with the updated cache. The layer stack scans the stacked block params
+    AND the cache's layer axis together, so depth costs one trace."""
+    h = params["embed"][tokens][:, None, :]               # (S, 1, d)
+
+    def step(h, xs):
+        layer_params, ck, cv = xs
+        h, ck, cv = _decode_block(layer_params, h, ck, cv, positions,
+                                  n_heads, top_k)
+        return h, (ck, cv)
+
+    h, (cks, cvs) = jax.lax.scan(
+        step, h, (params["blocks"], cache["k"], cache["v"]))
+    logits = (h @ params["dec_w"] + params["dec_b"])[:, 0, :]
+    return {"k": cks, "v": cvs}, logits
+
+
+def sample_tokens(logits: Array, key: Array, temperature: Array) -> Array:
+    """Fused sampling: greedy argmax where ``temperature <= 0``, else
+    temperature-scaled categorical — selected in-graph so ONE compiled
+    step serves any mix of greedy and sampling requests (per-slot
+    temperature vector; no retrace when the mix changes)."""
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits / jnp.maximum(temperature, 1e-6)[..., None]
+    sampled = jax.random.categorical(key, scaled)
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+
+
+def make_decode_step(n_heads: int, top_k: int = 2, donate_cache: bool = True,
+                     params_transform=None):
+    """The serving engine's hot executable:
+    ``step(params, cache, tokens, positions, temps, key, step_idx) ->
+    (cache, next_tokens)``. Shapes are FIXED at the slot count — occupancy
+    changes never retrace (0-compile steady state pinned in
+    tests/test_serve.py); ``step_idx`` is folded into the key in-graph so
+    the host never advances RNG state. ``donate_cache`` donates the old
+    cache buffers into the update (the engine always rebinds).
+    ``params_transform`` runs inside the jit — the serve_dtype seam's
+    int8→bf16 dequantization hook (serve/quant.py); None = identity."""
+    transform = params_transform or (lambda p: p)
+
+    @partial(jax.jit, donate_argnums=(1,) if donate_cache else ())
+    def step(params, cache, tokens, positions, temps, key, step_idx):
+        params = transform(params)
+        cache, logits = lm_decode_step(params, cache, tokens, positions,
+                                       n_heads, top_k)
+        k = jax.random.fold_in(key, step_idx)
+        return cache, sample_tokens(logits, k, temps)
+
+    return step
+
+
+def make_prefill_step(n_heads: int, top_k: int = 2,
+                      attn_impl: Optional[str] = None,
+                      donate_cache: bool = True, params_transform=None):
+    """Admission executable: ``prefill(params, cache, tokens, last_idx,
+    slot, temp, key, step_idx) -> (cache, first_token)`` — the prompt pass
+    (through the attn_impl seam), the cache-page write at ``slot``, and the
+    first sampled token fused into one dispatch. ``tokens`` is (1, T_pad)
+    right-padded to the engine's bucket, so compiles are bounded by the
+    bucket count (slot/last_idx are traced)."""
+    transform = params_transform or (lambda p: p)
+
+    @partial(jax.jit, donate_argnums=(1,) if donate_cache else ())
+    def prefill(params, cache, tokens, last_idx, slot, temp, key, step_idx):
+        params = transform(params)
+        logits, ks, vs = lm_prefill(params, tokens, n_heads, top_k,
+                                    attn_impl)
+        ck = jax.lax.dynamic_update_slice(
+            cache["k"], ks.astype(cache["k"].dtype), (0, slot, 0, 0, 0))
+        cv = jax.lax.dynamic_update_slice(
+            cache["v"], vs.astype(cache["v"].dtype), (0, slot, 0, 0, 0))
+        last = jax.lax.dynamic_index_in_dim(logits[0], last_idx, 0,
+                                            keepdims=False)
+        k = jax.random.fold_in(key, step_idx)
+        return {"k": ck, "v": cv}, sample_tokens(last, k, temp)
+
+    return prefill
+
+
+def lm_dims(params: dict) -> dict:
+    """Model dimensions recoverable from the params pytree alone (serving
+    needs them to size caches and validate requests): everything except
+    ``n_heads``, which the head-split erases — that one travels in
+    checkpoint meta (``lm_checkpoint_meta``) or a CLI flag."""
+    vocab, d_model = params["embed"].shape
+    w1 = params["blocks"]["experts"]["w1"]
+    n_layers, n_experts, _, d_ff = w1.shape
+    return {"vocab": int(vocab), "d_model": int(d_model),
+            "n_layers": int(n_layers), "n_experts": int(n_experts),
+            "d_ff": int(d_ff)}
+
+
+def lm_checkpoint_meta(params: dict, n_heads: int, top_k: int = 2) -> dict:
+    """Checkpoint ``meta`` block letting ``DecodeEngine.from_checkpoint``
+    rebuild the decode path with zero side-channel config: pass as
+    ``meta=lm_checkpoint_meta(...)`` (or merge the dict) to
+    ``Checkpointer.save``."""
+    return {"lm": {**lm_dims(params), "n_heads": int(n_heads),
+                   "top_k": int(top_k)}}
+
+
 def lm_replay(n_heads: int, top_k: int = 2, aux_weight: float = 1e-2,
               attn_impl: Optional[str] = None):
     """``tools/step_replay.py`` factory for flagship-LM replay bundles

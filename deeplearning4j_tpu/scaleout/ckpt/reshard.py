@@ -25,6 +25,7 @@ broadcast, truncated, or ``astype``-narrowed on the way in.
 from __future__ import annotations
 
 import os
+import re
 import zlib
 from typing import Dict, List, Optional, Tuple
 
@@ -236,6 +237,37 @@ def restore_sharded(step_dir: str, template, shardings=None,
                         e, store, idx, d)))
     return jax.tree_util.tree_unflatten(
         treedef, new_leaves), manifest
+
+
+_KEYSTR_SEG = re.compile(r"\['([^']*)'\]")
+
+
+def template_from_manifest(manifest: Manifest):
+    """Rebuild a zeroed template pytree from the manifest alone (leaf
+    paths + global shapes + dtypes) — template-free restore for consumers
+    that don't hold the training-time structure, e.g. the serving engine
+    loading an LM checkpoint (``serve.DecodeEngine.from_checkpoint``).
+    Supports string-keyed nested dicts, the layout every checkpoint in
+    this tree uses; anything else raises rather than guessing."""
+    tree: Dict = {}
+    for entry in manifest.leaves:
+        keys = _KEYSTR_SEG.findall(entry.path)
+        if "".join(f"['{k}']" for k in keys) != entry.path or not keys:
+            raise ValueError(
+                f"manifest leaf path {entry.path!r} is not a string-keyed "
+                "dict path — template-free restore supports dict pytrees "
+                "only; restore with an explicit template instead")
+        try:
+            dtype = np.dtype(entry.dtype)
+        except TypeError:
+            import ml_dtypes  # extension dtypes (bfloat16) ship with jax
+
+            dtype = np.dtype(getattr(ml_dtypes, entry.dtype))
+        node = tree
+        for k in keys[:-1]:
+            node = node.setdefault(k, {})
+        node[keys[-1]] = np.zeros(tuple(entry.shape), dtype)
+    return tree
 
 
 def verify_checksums(step_dir: str) -> List[str]:

@@ -1,0 +1,31 @@
+"""Serving subsystem: KV-cached autoregressive decode with continuous
+batching (ISSUE 10 / ROADMAP 2). See serve/engine.py for the architecture
+overview; decode-mode model math lives in models/transformer_lm.py and the
+serving-precision seam in serve/quant.py."""
+
+from deeplearning4j_tpu.serve.engine import DecodeEngine, ServeRequest
+from deeplearning4j_tpu.serve.loadgen import (
+    LoadReport,
+    arrival_schedule,
+    run_open_loop,
+    run_open_loop_http,
+)
+from deeplearning4j_tpu.serve.quant import (
+    QuantTensor,
+    dequantize_tree,
+    params_nbytes,
+    prepare_serve_params,
+)
+
+__all__ = [
+    "DecodeEngine",
+    "ServeRequest",
+    "LoadReport",
+    "arrival_schedule",
+    "run_open_loop",
+    "run_open_loop_http",
+    "QuantTensor",
+    "dequantize_tree",
+    "params_nbytes",
+    "prepare_serve_params",
+]

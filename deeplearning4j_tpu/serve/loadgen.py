@@ -1,0 +1,171 @@
+"""Synthetic open-loop traffic generator for the decode engine.
+
+Open-loop means arrivals follow a fixed schedule (Poisson: exponential
+inter-arrival gaps) that does NOT slow down when the server falls behind —
+the honest way to measure serving latency, because a closed loop (submit →
+wait → submit) throttles itself to the server's pace and hides queueing
+delay. Latency here is measured from the SCHEDULED arrival, so time spent
+waiting in the queue (or waiting for the driver to catch up) counts
+against the server, exactly as a user would experience it.
+
+Two drivers:
+
+- :func:`run_open_loop` — in-process against a ``DecodeEngine``: one
+  thread interleaves due submissions with ``engine.step()`` calls (the
+  bench path: no HTTP noise in the numbers).
+- :func:`run_open_loop_http` — against a ``UiServer`` URL: a thread per
+  request POSTs ``/api/generate`` at its scheduled arrival (the end-to-end
+  front-end smoke).
+
+Both return a :class:`LoadReport` with tokens/s and exact (not
+bucket-approximated) p50/p95 latency over the recorded per-request
+latencies — the numbers ``bench.py serve`` publishes and
+``tools/bench_report.py`` tracks as LOWER-IS-BETTER rows.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.request
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class LoadReport:
+    """One load run's results. Latencies are milliseconds, measured from
+    each request's scheduled arrival to its completion."""
+
+    n_requests: int
+    completed: int
+    duration_s: float
+    tokens_out: int
+    tokens_per_sec: float
+    offered_rps: float
+    latency_p50_ms: float
+    latency_p95_ms: float
+    latency_mean_ms: float
+    first_token_p50_ms: Optional[float] = None
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def arrival_schedule(n: int, rate_rps: float, seed: int = 0) -> List[float]:
+    """Poisson arrival offsets (seconds from start) for ``n`` requests at
+    ``rate_rps`` mean offered load."""
+    if rate_rps <= 0:
+        raise ValueError(f"rate_rps must be > 0, got {rate_rps}")
+    rng = np.random.RandomState(seed)
+    gaps = rng.exponential(1.0 / rate_rps, size=n)
+    return list(np.cumsum(gaps))
+
+
+def _percentiles(values_ms: List[float]) -> tuple:
+    if not values_ms:
+        return 0.0, 0.0, 0.0
+    arr = np.asarray(values_ms)
+    return (float(np.percentile(arr, 50)), float(np.percentile(arr, 95)),
+            float(arr.mean()))
+
+
+def run_open_loop(engine, prompts: Sequence[Sequence[int]],
+                  rate_rps: float, max_new_tokens: int = 16,
+                  temperature: float = 0.0, seed: int = 0,
+                  timeout_s: float = 300.0) -> LoadReport:
+    """Drive ``engine`` with open-loop arrivals of ``prompts`` (one
+    request each, in order) at ``rate_rps``. The engine must NOT be
+    running its background loop — this driver owns the step cadence so the
+    measurement is single-threaded and reproducible."""
+    offsets = arrival_schedule(len(prompts), rate_rps, seed=seed)
+    t0 = time.perf_counter()
+    deadline = t0 + timeout_s
+    pending = list(zip(offsets, prompts))
+    requests = []  # (scheduled_arrival_abs, ServeRequest)
+    while pending or engine.has_work():
+        now = time.perf_counter()
+        if now > deadline:
+            raise TimeoutError(
+                f"open-loop run exceeded {timeout_s}s with "
+                f"{len(pending)} requests unsubmitted")
+        while pending and t0 + pending[0][0] <= now:
+            offset, prompt = pending.pop(0)
+            req = engine.submit(prompt, max_new_tokens=max_new_tokens,
+                                temperature=temperature)
+            requests.append((t0 + offset, req))
+        if engine.has_work():
+            engine.step()
+        elif pending:
+            time.sleep(min(0.002, t0 + pending[0][0] - now))
+    t_end = time.perf_counter()
+    lat, first = [], []
+    tokens = 0
+    done = 0
+    for arrival, req in requests:
+        if req.t_done is None:
+            continue
+        done += 1
+        tokens += len(req.generated)
+        lat.append((req.t_done - arrival) * 1000.0)
+        if req.t_first is not None:
+            first.append((req.t_first - arrival) * 1000.0)
+    p50, p95, mean = _percentiles(lat)
+    duration = t_end - t0
+    return LoadReport(
+        n_requests=len(prompts), completed=done, duration_s=duration,
+        tokens_out=tokens,
+        tokens_per_sec=tokens / duration if duration > 0 else 0.0,
+        offered_rps=rate_rps, latency_p50_ms=p50, latency_p95_ms=p95,
+        latency_mean_ms=mean,
+        first_token_p50_ms=_percentiles(first)[0] if first else None)
+
+
+def run_open_loop_http(base_url: str, prompts: Sequence[Sequence[int]],
+                       rate_rps: float, max_new_tokens: int = 16,
+                       temperature: float = 0.0, seed: int = 0,
+                       timeout_s: float = 120.0) -> LoadReport:
+    """Open-loop arrivals POSTed to ``<base_url>/api/generate`` (the
+    UiServer front-end; the server-side engine must be ``start()``ed).
+    One thread per request fires at its scheduled arrival."""
+    offsets = arrival_schedule(len(prompts), rate_rps, seed=seed)
+    results: List[Optional[dict]] = [None] * len(prompts)
+    lat_ms: List[Optional[float]] = [None] * len(prompts)
+    t0 = time.perf_counter()
+
+    def fire(i: int, offset: float, prompt):
+        delay = t0 + offset - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        body = json.dumps({"prompt": list(map(int, prompt)),
+                           "max_new_tokens": max_new_tokens,
+                           "temperature": temperature}).encode()
+        req = urllib.request.Request(
+            base_url.rstrip("/") + "/api/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        start = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            results[i] = json.loads(resp.read())
+        lat_ms[i] = (time.perf_counter() - (t0 + offset)) * 1000.0
+
+    threads = [threading.Thread(target=fire, args=(i, off, p), daemon=True)
+               for i, (off, p) in enumerate(zip(offsets, prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout_s)
+    t_end = time.perf_counter()
+    done = [i for i, r in enumerate(results) if r is not None]
+    tokens = sum(len(results[i].get("tokens", [])) for i in done)
+    p50, p95, mean = _percentiles([lat_ms[i] for i in done
+                                   if lat_ms[i] is not None])
+    duration = t_end - t0
+    return LoadReport(
+        n_requests=len(prompts), completed=len(done), duration_s=duration,
+        tokens_out=tokens,
+        tokens_per_sec=tokens / duration if duration > 0 else 0.0,
+        offered_rps=rate_rps, latency_p50_ms=p50, latency_p95_ms=p95,
+        latency_mean_ms=mean)

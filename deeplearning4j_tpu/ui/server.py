@@ -32,6 +32,11 @@ INDEX_HTML = """<!doctype html>
 <li><a href="/api/trace">live trace spans (open + recent)</a></li>
 <li><a href="/api/profile">compiled-step profiles (cost/memory/collectives)</a></li>
 </ul>
+<h2>serving</h2>
+<ul>
+<li><a href="/api/serve">decode-engine stats (queue, slots, throughput)</a></li>
+<li>POST /api/generate {"prompt": [ids], "max_new_tokens": N, "temperature": T}</li>
+</ul>
 <h2>api</h2>
 <ul>
 <li><a href="/api/words">word vectors (count)</a></li>
@@ -68,6 +73,8 @@ class UiServer:
         self._metrics_registry = None
         self._tracer = None
         self._profile_store = None
+        self._engine = None
+        self._generate_timeout_s = 120.0
 
     # ---- telemetry (ISSUE 2: Prometheus + JSON export on the UI port) ----
     def attach_metrics(self, registry) -> None:
@@ -94,6 +101,20 @@ class UiServer:
         to the process default store when none is attached — a train step
         built with ``profile=True`` is visible with zero extra wiring."""
         self._profile_store = store
+
+    # ---- serving (ISSUE 10: the decode engine behind /api/generate) ----
+    def attach_engine(self, engine, generate_timeout_s: float = 120.0
+                      ) -> None:
+        """Serve a serve.DecodeEngine: POST ``/api/generate`` submits a
+        generation request (blocking until the request retires — handler
+        threads ride the ThreadingHTTPServer, the engine's continuous-
+        batching loop interleaves them into slots) and GET ``/api/serve``
+        snapshots scheduler stats (queue depth, slot occupancy, token
+        throughput). Start the engine's background loop
+        (``engine.start()``) for concurrent requests; without it each
+        handler drives the scheduler inline."""
+        self._engine = engine
+        self._generate_timeout_s = float(generate_timeout_s)
 
     # ---- uploads (ref ApiResource: the reference POSTs these; in-process
     # registration serves the same purpose without copying through HTTP) ----
@@ -215,6 +236,12 @@ class UiServer:
                         self._json(rec)
                         return
                     self._json({"profiles": store.snapshot()})
+                elif url.path == "/api/serve":
+                    if ui._engine is None:
+                        self._json({"error": "no decode engine attached"},
+                                   404)
+                        return
+                    self._json(ui._engine.stats())
                 elif url.path == "/api/words":
                     self._json({"count": len(ui._words), "words": ui._words[:200]})
                 elif url.path == "/api/nearest":
@@ -261,6 +288,83 @@ class UiServer:
                         self._send(200, fh.read(), ctype)
                 else:
                     self._json({"error": "not found"}, 404)
+
+            # ---- POST plumbing (ISSUE 10 satellite: the reference's
+            # ApiResource accepted uploads over POST; this build needed it
+            # for /api/generate — minimal routing with explicit
+            # content-length and JSON error handling, pinned in
+            # tests/test_ui.py) ----
+            _MAX_BODY = 8 << 20  # 8 MiB: a prompt is a token list, not data
+
+            def _read_json_body(self):
+                """Parse the request body, answering the error response
+                directly on failure (None = already responded): 411 on a
+                missing Content-Length, 400 on an invalid one or non-JSON
+                body, 413 past the size cap."""
+                cl = self.headers.get("Content-Length")
+                if cl is None:
+                    self._json({"error": "Content-Length required"}, 411)
+                    return None
+                try:
+                    length = int(cl)
+                except ValueError:
+                    self._json({"error": "invalid Content-Length"}, 400)
+                    return None
+                if length < 0:
+                    self._json({"error": "invalid Content-Length"}, 400)
+                    return None
+                if length > self._MAX_BODY:
+                    self._json({"error": "body too large"}, 413)
+                    return None
+                raw = self.rfile.read(length)
+                try:
+                    return json.loads(raw.decode("utf-8"))
+                except (UnicodeDecodeError, ValueError):
+                    self._json({"error": "body is not valid JSON"}, 400)
+                    return None
+
+            def do_POST(self):
+                url = urlparse(self.path)
+                if url.path != "/api/generate":
+                    self._json({"error": "not found"}, 404)
+                    return
+                if ui._engine is None:
+                    self._json({"error": "no decode engine attached"}, 404)
+                    return
+                payload = self._read_json_body()
+                if payload is None:
+                    return
+                if not isinstance(payload, dict):
+                    self._json({"error": "body must be a JSON object"}, 400)
+                    return
+                prompt = payload.get("prompt")
+                if (not isinstance(prompt, list) or not prompt
+                        or not all(isinstance(t, int)
+                                   and not isinstance(t, bool)
+                                   for t in prompt)):
+                    self._json({"error": "prompt must be a non-empty list "
+                                "of token ids"}, 400)
+                    return
+                try:
+                    max_new = int(payload.get("max_new_tokens", 16))
+                    temperature = float(payload.get("temperature", 0.0))
+                except (TypeError, ValueError):
+                    self._json({"error": "max_new_tokens/temperature must "
+                                "be numbers"}, 400)
+                    return
+                try:
+                    tokens = ui._engine.generate(
+                        prompt, max_new_tokens=max_new,
+                        temperature=temperature,
+                        timeout=ui._generate_timeout_s)
+                except ValueError as exc:  # engine-side validation
+                    self._json({"error": str(exc)}, 400)
+                    return
+                except TimeoutError:
+                    self._json({"error": "generation timed out"}, 503)
+                    return
+                self._json({"tokens": tokens, "n": len(tokens),
+                            "prompt_len": len(prompt)})
 
         return Handler
 

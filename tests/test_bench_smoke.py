@@ -366,6 +366,55 @@ def test_bench_profile_stage_on_cpu():
     assert sd["overhead_pct"] < 5.0, sd
 
 
+def test_bench_serve_stage_on_cpu():
+    """ISSUE 10 acceptance: the serve stage runs end to end on the CPU
+    backend — the continuous-batching decode engine beats the naive
+    recompute-per-token baseline on tokens/s (same bf16 weights, so the
+    ratio isolates the KV cache + batching), p50/p95 latency lands under
+    the open-loop traffic generator, every request completes, and the
+    int8 weight-only twin reports its smaller at-rest footprint.
+
+    The throughput ratio shares the shared-CPU noise floor of the other
+    A/B stages — one retry keeps the gate honest (the measured margin is
+    ~2x; a real regression, like a retrace per occupancy change, lands
+    well under 1.0 on both runs)."""
+
+    def run_stage():
+        env = dict(os.environ)
+        env["BENCH_FORCE_CPU"] = "1"
+        env["BENCH_FAST"] = "1"
+        env["BENCH_BUDGET_SEC"] = "240"
+        env["BENCH_ONLY"] = "serve"
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True, text=True, timeout=300, cwd=REPO, env=env,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        det = json.loads(out.stdout.strip().splitlines()[-1])["detail"]
+        assert det.get("serve_tokens_per_sec"), det.get("serve_status")
+        return det
+
+    det = run_stage()
+    sd = det["serve_detail"]
+    # stable structure (no retry needed)
+    assert det["serve_tokens_per_sec"] == sd["tokens_per_sec"]
+    assert sd["completed"] == sd["n_requests"]
+    lat = sd["latency"]
+    assert lat["p95_ms"] >= lat["p50_ms"] > 0
+    assert lat["mean_ms"] > 0
+    assert sd["naive_tokens_per_sec"] > 0
+    assert sd["occupancy_mean"] > 0
+    assert sd["serve_dtype"] == "bf16"
+    # int8 A/B twin: decodes, and the at-rest weights really shrank
+    assert sd["int8"]["tokens_per_sec"] > 0
+    assert sd["int8"]["weight_bytes"] < sd["weight_bytes"]
+    assert sd["int8"]["weight_bytes_vs_bf16"] < 1.0
+    # the acceptance ratio: continuous batching beats recompute-per-token
+    if sd["serve_vs_naive"] <= 1.0:  # noise-floor retry, see docstring
+        sd = run_stage()["serve_detail"]
+    assert sd["serve_vs_naive"] > 1.0, sd
+
+
 # ------------------------------------------------ stage-coverage meta-test ----
 
 # Stages that predate this meta-test and whose plumbing is the ONE shared

@@ -107,6 +107,82 @@ def test_mem_uri_model_round_trip(conf_path, iris_csv, capsys):
     assert not os.path.exists("mem:")
 
 
+def test_predict_lm_checkpoint_generates_through_decode_engine(tmp_path,
+                                                               capsys):
+    """ISSUE 10 satellite: ``predict --model <ckpt_dir>`` routes LM
+    checkpoints through the KV-cached decode engine (no --conf needed) and
+    the output matches a direct engine run with the same knobs; non-LM
+    predicts keep the classic path (pinned above)."""
+    import jax
+
+    from deeplearning4j_tpu.models.transformer_lm import (
+        init_lm_params,
+        lm_checkpoint_meta,
+    )
+    from deeplearning4j_tpu.scaleout.ckpt.checkpointer import Checkpointer
+    from deeplearning4j_tpu.serve import DecodeEngine
+
+    params = init_lm_params(jax.random.PRNGKey(0), 31, 8, 2, 2, 16,
+                            n_layers=2)
+    root = str(tmp_path / "lm_ckpt")
+    Checkpointer(root).save(7, {"params": params},
+                            meta=lm_checkpoint_meta(params, 2))
+    prompts = tmp_path / "prompts.txt"
+    prompts.write_text("1 2 3 4\n10, 20, 30\n\n5 6\n")
+    out_path = str(tmp_path / "gen.txt")
+    rc = main(["predict", "--model", root, "--input", str(prompts),
+               "--output", out_path, "--max-new-tokens", "4",
+               "--serve-dtype", "f32"])
+    assert rc == 0
+    rows = [[int(t) for t in line.split()]
+            for line in open(out_path).read().strip().splitlines()]
+    assert len(rows) == 3  # blank prompt lines are skipped
+    assert all(len(r) == 4 for r in rows)
+
+    eng = DecodeEngine.from_checkpoint(root, serve_dtype="f32")
+    want = [eng.generate(p, max_new_tokens=4)
+            for p in ([1, 2, 3, 4], [10, 20, 30], [5, 6])]
+    assert rows == want
+
+    # stdout path + verbose engine stats line
+    rc = main(["predict", "--model", root, "--input", str(prompts),
+               "--max-new-tokens", "2", "--serve-dtype", "f32",
+               "--verbose"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert len(out.strip().splitlines()) == 3 + 1  # 3 rows + stats line
+    assert "decode engine:" in out
+
+
+def test_predict_lm_rejects_bad_prompt_file(tmp_path):
+    import jax
+
+    from deeplearning4j_tpu.models.transformer_lm import (
+        init_lm_params,
+        lm_checkpoint_meta,
+    )
+    from deeplearning4j_tpu.scaleout.ckpt.checkpointer import Checkpointer
+
+    params = init_lm_params(jax.random.PRNGKey(0), 31, 8, 2, 2, 16)
+    root = str(tmp_path / "lm_ckpt")
+    Checkpointer(root).save(1, {"params": params},
+                            meta=lm_checkpoint_meta(params, 2))
+    bad = tmp_path / "bad.txt"
+    bad.write_text("1 2 three\n")
+    with pytest.raises(SystemExit, match="token ids"):
+        main(["predict", "--model", root, "--input", str(bad)])
+    empty = tmp_path / "empty.txt"
+    empty.write_text("\n\n")
+    with pytest.raises(SystemExit, match="no prompts"):
+        main(["predict", "--model", root, "--input", str(empty)])
+
+
+def test_predict_without_conf_on_non_lm_model_errors(tmp_path, iris_csv):
+    with pytest.raises(SystemExit, match="--conf is required"):
+        main(["predict", "--model", str(tmp_path / "nope.npz"),
+              "--input", iris_csv])
+
+
 def test_split_store_uri():
     from deeplearning4j_tpu.scaleout.blobstore import split_store_uri
 

@@ -606,6 +606,11 @@ def test_tracker_non_idempotent_fails_fast_without_retry():
     client = StateTrackerClient(server.address, request_timeout_s=0.5,
                                 retries=2, backoff_s=0.01, registry=reg)
     server.shutdown()
+    # shutdown() stops the ACCEPT loop, but the handler thread already
+    # serving this client's established socket may live on briefly — drop
+    # the socket so the call must reconnect against the closed listener
+    # (deterministic refusal; the scenario the test is about)
+    client._drop_socket()
     with pytest.raises(TrackerUnavailable):
         client.increment("jobs_done")
     assert reg.counter("tracker_retries_total").value == 0

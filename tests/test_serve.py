@@ -1,0 +1,419 @@
+"""Serving subsystem (ISSUE 10): KV-cached decode correctness against the
+recompute-per-token full-forward oracle (dense AND blockwise prefill,
+multi-block, MoE layers), cache eviction/readmission parity under
+mid-stream turnover, the 0-compile steady-state decode retrace budget,
+the serve_dtype quantization seam, the open-loop load generator, and the
+template-free checkpoint restore behind ``DecodeEngine.from_checkpoint``.
+"""
+
+import functools
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.transformer_lm import (
+    dense_moe,
+    init_kv_cache,
+    init_lm_params,
+    lm_checkpoint_meta,
+    lm_dims,
+    lm_forward,
+    lm_prefill,
+)
+from deeplearning4j_tpu.ops.flash_attention import attention_core
+from deeplearning4j_tpu.serve import (
+    DecodeEngine,
+    QuantTensor,
+    arrival_schedule,
+    params_nbytes,
+    prepare_serve_params,
+    run_open_loop,
+)
+
+V, D, H, E, DFF, L = 61, 16, 2, 4, 32, 2
+MAXLEN = 32
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_lm_params(jax.random.PRNGKey(0), V, D, H, E, DFF,
+                          n_layers=L)
+
+
+def _prompts(n, seed=1, lo=3, hi=12):
+    rng = np.random.RandomState(seed)
+    return [list(map(int, rng.randint(0, V, rng.randint(lo, hi))))
+            for _ in range(n)]
+
+
+@functools.lru_cache(maxsize=None)
+def _oracle_fwd(attn_impl):
+    """The full-forward logits fn the oracle recomputes per token — the
+    EXACT training forward (lm_forward) with the dense MoE and the given
+    attention core; jit-cached per (impl, length) across tests."""
+    core = lambda q, k, v: attention_core(q, k, v, causal=True,  # noqa: E731
+                                          impl=attn_impl)
+    moe = lambda rw, ex, x: dense_moe(rw, ex, x, 2)  # noqa: E731
+    return jax.jit(lambda p, t: lm_forward(p, t, H, core, moe)[0],
+                   donate_argnums=())
+
+
+def _oracle_greedy(params, prompt, max_new, attn_impl=None):
+    """Recompute-per-token: at every step the FULL sequence so far runs
+    through the training forward and the last position's argmax extends
+    it — the O(t)-per-token reference the decode engine must reproduce
+    token-for-token."""
+    fwd = _oracle_fwd(attn_impl)
+    toks = list(prompt)
+    out = []
+    for _ in range(max_new):
+        logits = fwd(params, jnp.asarray([toks], jnp.int32))
+        nxt = int(jnp.argmax(logits[0, len(toks) - 1]))
+        out.append(nxt)
+        toks.append(nxt)
+    return out
+
+
+# ------------------------------------------------------- decode parity ----
+
+def test_prefill_logits_bit_identical_to_training_forward(params):
+    """lm_prefill IS the training forward plus K/V outputs: logits must be
+    bit-identical (not just close) for both attention cores."""
+    toks = jnp.asarray([_prompts(1, seed=7, lo=8, hi=9)[0]], jnp.int32)
+    for impl in ("dense", "blockwise"):
+        fwd = _oracle_fwd(impl)
+        want = np.asarray(fwd(params, toks))
+        logits, ks, vs = jax.jit(
+            lambda p, t, i=impl: lm_prefill(p, t, H, attn_impl=i),
+            donate_argnums=())(params, toks)
+        assert np.array_equal(np.asarray(logits), want), impl
+        assert ks.shape == (L, 1, H, toks.shape[1], D // H)
+        assert vs.shape == ks.shape
+
+
+@pytest.mark.parametrize("attn_impl", ["dense", "blockwise"])
+def test_greedy_decode_matches_full_forward_oracle(params, attn_impl):
+    """Acceptance criterion: the engine's greedy token sequence is
+    bit-identical to the recompute-per-token oracle — multi-block (L=2),
+    MoE FFNs, both prefill cores, varying prompt lengths (so both prefill
+    buckets and the padded-cache attention mask are exercised)."""
+    eng = DecodeEngine(params, H, n_slots=2, max_len=MAXLEN,
+                       serve_dtype=None, attn_impl=attn_impl)
+    for prompt in _prompts(3, seed=2):
+        got = eng.generate(prompt, max_new_tokens=6)
+        want = _oracle_greedy(params, prompt, 6, attn_impl)
+        assert got == want, (prompt, got, want)
+
+
+def test_eviction_readmission_parity_under_turnover(params):
+    """2 slots, 7 requests submitted up front: every request's output must
+    match its isolated oracle even though slots are freed and reused
+    mid-stream (stale cache pages from evicted requests must never leak
+    into a readmitted one)."""
+    eng = DecodeEngine(params, H, n_slots=2, max_len=MAXLEN,
+                       serve_dtype=None)
+    prompts = _prompts(7, seed=3)
+    reqs = [eng.submit(p, max_new_tokens=4) for p in prompts]
+    eng.run_until_idle()
+    assert all(r.done.is_set() for r in reqs)
+    # turnover really happened: more requests than slots, all completed
+    assert eng.stats()["requests_total"] == 7
+    for p, r in zip(prompts, reqs):
+        want = _oracle_greedy(params, p, 4)
+        assert r.generated == want, (p, r.generated, want)
+    # occupancy was shared: the scheduler interleaved, not serialized
+    assert eng.stats()["occupancy_mean"] > 1.0
+
+
+def test_decode_steady_state_zero_retrace(params, retrace_budget):
+    """ISSUE 10 satellite: with prefill buckets warmed, the decode loop
+    holds a 0-compile budget across admissions, occupancy changes, and
+    slot turnover — the continuous-batching scheduler can never pay a
+    retrace for a varying active-request count."""
+    eng = DecodeEngine(params, H, n_slots=3, max_len=MAXLEN,
+                       serve_dtype=None)
+    # warm both buckets the traffic below hits (8 and 16) + the decode step
+    eng.generate([1] * 5, max_new_tokens=2)
+    eng.generate([1] * 12, max_new_tokens=2)
+    p = _prompts(6, seed=4)  # lengths 3..11 → buckets {8, 16}
+    with retrace_budget(0, label="serve steady-state decode"):
+        r1 = eng.submit(p[0], max_new_tokens=4)
+        eng.step()  # occupancy 1
+        r2 = eng.submit(p[1], max_new_tokens=6)
+        r3 = eng.submit(p[2], max_new_tokens=3)
+        eng.run_until_idle()  # occupancy up to 3, then draining
+        # readmission wave into freed slots
+        r4 = eng.submit(p[3], max_new_tokens=5)
+        r5 = eng.submit(p[4], max_new_tokens=2)
+        eng.run_until_idle()
+    for r in (r1, r2, r3, r4, r5):
+        assert r.done.is_set() and r.finish_reason == "max_new_tokens"
+
+
+def test_mixed_greedy_and_sampled_slots_one_executable(params):
+    """Greedy and temperature requests ride the SAME decode executable
+    (in-graph select on the per-slot temperature vector): a greedy request
+    batched next to a sampling one still matches the oracle."""
+    eng = DecodeEngine(params, H, n_slots=2, max_len=MAXLEN,
+                       serve_dtype=None)
+    prompt_g, prompt_s = _prompts(2, seed=5)
+    rg = eng.submit(prompt_g, max_new_tokens=5, temperature=0.0)
+    rs = eng.submit(prompt_s, max_new_tokens=5, temperature=1.0)
+    eng.run_until_idle()
+    assert rg.generated == _oracle_greedy(params, prompt_g, 5)
+    assert len(rs.generated) == 5
+    assert all(0 <= t < V for t in rs.generated)
+
+
+def test_sampling_reproducible_per_engine_seed(params):
+    """Same seed + same submission order → identical sampled streams;
+    different seed → (overwhelmingly) different."""
+    prompt = _prompts(1, seed=6)[0]
+
+    def run(seed):
+        eng = DecodeEngine(params, H, n_slots=2, max_len=MAXLEN,
+                           serve_dtype=None, seed=seed)
+        return eng.generate(prompt, max_new_tokens=8, temperature=1.0)
+
+    assert run(0) == run(0)
+    assert run(0) != run(123)
+
+
+def test_eos_retires_slot_and_excludes_token(params):
+    """EOS eviction: pick the token the greedy oracle emits mid-stream as
+    the EOS id — the engine must stop there, exclude it, and free the
+    slot for the queue."""
+    prompt = _prompts(1, seed=2)[0]
+    oracle = _oracle_greedy(params, prompt, 6)
+    eos = oracle[2]
+    cut = oracle.index(eos)  # greedy streams repeat tokens: first hit wins
+    eng = DecodeEngine(params, H, n_slots=1, max_len=MAXLEN,
+                       serve_dtype=None, eos_id=eos)
+    out = eng.generate(prompt, max_new_tokens=6)
+    assert out == oracle[:cut]
+    assert eos not in out
+    st = eng.stats()
+    assert st["active_slots"] == 0 and st["queue_depth"] == 0
+
+
+def test_max_len_evicts_at_cache_capacity(params):
+    """A request that would outrun its cache page retires with
+    finish_reason="max_len" instead of writing out of bounds."""
+    eng = DecodeEngine(params, H, n_slots=1, max_len=16, serve_dtype=None)
+    prompt = [1] * 12
+    req = eng.submit(prompt, max_new_tokens=50)
+    eng.run_until_idle()
+    assert req.finish_reason == "max_len"
+    # cache positions 12..15 hold generated tokens; the final sample (from
+    # position 15's logits) needs no write, so capacity yields
+    # max_len - len(prompt) + 1 tokens
+    assert len(req.generated) == 16 - 12 + 1
+
+
+def test_submit_validation(params):
+    eng = DecodeEngine(params, H, n_slots=1, max_len=16, serve_dtype=None)
+    with pytest.raises(ValueError):
+        eng.submit([])
+    with pytest.raises(ValueError):
+        eng.submit([V + 5])
+    with pytest.raises(ValueError):
+        eng.submit([1] * 16)  # needs one free position to generate
+    with pytest.raises(ValueError):
+        eng.submit([1, 2], max_new_tokens=0)
+    with pytest.raises(ValueError):
+        DecodeEngine(params, H, serve_dtype="fp7")
+
+
+# ------------------------------------------------------ serve_dtype seam ----
+
+def test_serve_dtype_twins_and_quant_error(params):
+    f32b = params_nbytes(prepare_serve_params(params, None))
+    bf16b = params_nbytes(prepare_serve_params(params, "bf16"))
+    q = prepare_serve_params(params, "int8")
+    int8b = params_nbytes(q)
+    assert int8b < bf16b < f32b
+    # every matmul weight got quantized; dequant error bounded by the
+    # per-channel step size
+    w = np.asarray(params["blocks"]["wq"], np.float32)
+    qt = q["blocks"]["wq"]
+    assert isinstance(qt, QuantTensor)
+    deq = np.asarray(qt.dequantize(), np.float32)
+    step = np.asarray(qt.scale, np.float32)
+    assert np.all(np.abs(deq - w) <= step + 1e-2 * np.abs(w) + 1e-6)
+    # biases/norm gains stay unquantized
+    assert not isinstance(q["blocks"]["ln_g"], QuantTensor)
+    # both twins actually decode
+    for dt in ("bf16", "int8"):
+        eng = DecodeEngine(params, H, n_slots=2, max_len=MAXLEN,
+                           serve_dtype=dt)
+        out = eng.generate(_prompts(1)[0], max_new_tokens=4)
+        assert len(out) == 4 and all(0 <= t < V for t in out)
+
+
+def test_serve_metrics_flow_through_registry(params):
+    from deeplearning4j_tpu.telemetry.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    eng = DecodeEngine(params, H, n_slots=2, max_len=MAXLEN,
+                       serve_dtype=None, registry=reg)
+    eng.generate(_prompts(1)[0], max_new_tokens=3)
+    assert reg.counter("serve_requests_total").value == 1
+    assert reg.counter("serve_tokens_total").value == 3
+    assert reg.counter("serve_completed_total",
+                       {"reason": "max_new_tokens"}).value == 1
+    assert reg.histogram("serve_prefill_ms").count >= 1
+    assert reg.histogram("serve_decode_step_ms").count >= 1
+    assert reg.histogram("serve_request_ms").count == 1
+
+
+# ------------------------------------------------------------- loadgen ----
+
+def test_arrival_schedule_deterministic():
+    a = arrival_schedule(16, 10.0, seed=3)
+    b = arrival_schedule(16, 10.0, seed=3)
+    assert a == b and len(a) == 16
+    assert all(x < y for x, y in zip(a, a[1:]))
+    with pytest.raises(ValueError):
+        arrival_schedule(4, 0.0)
+
+
+def test_open_loop_drives_engine_to_completion(params):
+    eng = DecodeEngine(params, H, n_slots=2, max_len=MAXLEN,
+                       serve_dtype=None)
+    eng.generate([1] * 5, max_new_tokens=2)  # warm
+    prompts = _prompts(6, seed=8)
+    rep = run_open_loop(eng, prompts, rate_rps=300.0, max_new_tokens=4)
+    assert rep.completed == rep.n_requests == 6
+    assert rep.tokens_out == 6 * 4
+    assert rep.tokens_per_sec > 0
+    assert rep.latency_p95_ms >= rep.latency_p50_ms > 0
+    assert rep.latency_mean_ms > 0
+    d = rep.to_dict()
+    assert d["offered_rps"] == 300.0
+
+
+# ----------------------------------------- checkpoint loading (serving) ----
+
+def test_template_from_manifest_matches_saved_tree(params, tmp_path):
+    from deeplearning4j_tpu.scaleout.ckpt import manifest as mf
+    from deeplearning4j_tpu.scaleout.ckpt.checkpointer import Checkpointer
+    from deeplearning4j_tpu.scaleout.ckpt.reshard import (
+        latest_step_dir,
+        template_from_manifest,
+    )
+
+    ck = Checkpointer(str(tmp_path / "ckpt"))
+    ck.save(1, {"params": params}, meta=lm_checkpoint_meta(params, H))
+    manifest = mf.read_manifest(latest_step_dir(str(tmp_path / "ckpt")))
+    template = template_from_manifest(manifest)
+    want = jax.tree_util.tree_leaves_with_path({"params": params})
+    got = jax.tree_util.tree_leaves_with_path(template)
+    assert len(want) == len(got)
+    for (wp, wl), (gp, gl) in zip(want, got):
+        assert jax.tree_util.keystr(wp) == jax.tree_util.keystr(gp)
+        assert tuple(np.shape(gl)) == tuple(np.shape(wl))
+        assert np.dtype(gl.dtype) == np.dtype(wl.dtype)
+
+
+def test_from_checkpoint_round_trip_and_meta(params, tmp_path):
+    from deeplearning4j_tpu.scaleout.ckpt.checkpointer import Checkpointer
+
+    root = str(tmp_path / "ckpt")
+    ck = Checkpointer(root)
+    ck.save(3, {"params": params}, meta=lm_checkpoint_meta(params, H))
+    eng = DecodeEngine.from_checkpoint(root, max_len=MAXLEN,
+                                       serve_dtype=None)
+    assert eng.n_heads == H and eng.dims == lm_dims(params)
+    prompt = _prompts(1, seed=9)[0]
+    # restored weights decode exactly like the in-memory ones
+    direct = DecodeEngine(params, H, max_len=MAXLEN, serve_dtype=None)
+    assert eng.generate(prompt, max_new_tokens=4) == \
+        direct.generate(prompt, max_new_tokens=4)
+
+
+def test_from_checkpoint_requires_heads_without_meta(params, tmp_path):
+    from deeplearning4j_tpu.scaleout.ckpt.checkpointer import Checkpointer
+
+    root = str(tmp_path / "ckpt")
+    Checkpointer(root).save(1, {"params": params})  # no lm meta
+    with pytest.raises(ValueError, match="n_heads"):
+        DecodeEngine.from_checkpoint(root, max_len=MAXLEN)
+    eng = DecodeEngine.from_checkpoint(root, n_heads=H, max_len=MAXLEN,
+                                       serve_dtype=None)
+    assert eng.n_heads == H
+
+
+def test_from_checkpoint_rejects_non_lm_tree(tmp_path):
+    from deeplearning4j_tpu.scaleout.ckpt.checkpointer import Checkpointer
+
+    root = str(tmp_path / "ckpt")
+    Checkpointer(root).save(1, {"params": {"w": np.ones((3, 3), np.float32)}})
+    with pytest.raises(ValueError, match="not a flagship-LM"):
+        DecodeEngine.from_checkpoint(root, n_heads=1)
+
+
+# ------------------------------------------- bench_report latency rows ----
+
+def _bench_round(path, p95_ms, tokens_per_sec):
+    rec = {"n": 1, "cmd": "bench", "rc": 0, "tail": "", "parsed": {
+        "metric": "m", "value": 1.0, "detail": {
+            "serve_tokens_per_sec": tokens_per_sec,
+            "serve_detail": {"latency": {"p50_ms": p95_ms / 2,
+                                         "p95_ms": p95_ms,
+                                         "mean_ms": p95_ms / 2}},
+        }}}
+    with open(path, "w") as fh:
+        json.dump(rec, fh)
+
+
+def test_bench_report_flags_latency_growth_lower_is_better(tmp_path):
+    """ISSUE 10 satellite: serving-latency rows are tracked LOWER-IS-
+    BETTER — growth past the threshold is a regression even when
+    throughput held."""
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tools.bench_report import build_trajectory, load_rounds
+
+    _bench_round(tmp_path / "BENCH_r01.json", p95_ms=10.0,
+                 tokens_per_sec=100.0)
+    _bench_round(tmp_path / "BENCH_r02.json", p95_ms=20.0,
+                 tokens_per_sec=100.0)
+    traj = build_trajectory(load_rounds(str(tmp_path)), threshold_pct=10.0)
+    rows = {r["metric"]: r for r in traj["table"]}
+    assert rows["serve_latency_p95_ms"]["lower_is_better"] is True
+    assert rows["serve_latency_p95_ms"]["regression"] is True
+    assert rows["serve_latency_p50_ms"]["regression"] is True
+    # throughput held → no flag on the rate row
+    assert rows["serve_tokens_per_sec"]["regression"] is False
+    flagged = {r["metric"] for r in traj["regressions"]}
+    assert "serve_latency_p95_ms" in flagged
+
+
+def test_bench_report_latency_improvement_not_flagged(tmp_path):
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tools.bench_report import build_trajectory, load_rounds
+
+    _bench_round(tmp_path / "BENCH_r01.json", p95_ms=20.0,
+                 tokens_per_sec=100.0)
+    _bench_round(tmp_path / "BENCH_r02.json", p95_ms=10.0,
+                 tokens_per_sec=100.0)
+    traj = build_trajectory(load_rounds(str(tmp_path)), threshold_pct=10.0)
+    rows = {r["metric"]: r for r in traj["table"]}
+    assert rows["serve_latency_p95_ms"]["regression"] is False
+
+
+# ---------------------------------------------------------- cache shape ----
+
+def test_init_kv_cache_layout(params):
+    cache = init_kv_cache(L, 3, H, D // H, MAXLEN)
+    assert cache["k"].shape == (L, 3, H, MAXLEN, D // H)
+    assert cache["v"].shape == cache["k"].shape
+    assert cache["k"].dtype == jnp.float32
+    assert not np.any(np.asarray(cache["k"]))  # zero-initialized

@@ -178,6 +178,135 @@ def test_weight_histograms_helper():
     assert sum(h["counts"]) == 4 * 3
 
 
+def _post(server, path, body: bytes, ctype="application/json"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{path}", data=body,
+        headers={"Content-Type": ctype})
+    with urllib.request.urlopen(req) as r:
+        return r.status, r.read()
+
+
+@pytest.fixture
+def lm_engine():
+    import jax
+
+    from deeplearning4j_tpu.models.transformer_lm import init_lm_params
+    from deeplearning4j_tpu.serve import DecodeEngine
+
+    params = init_lm_params(jax.random.PRNGKey(0), 31, 8, 2, 2, 16,
+                            n_layers=1)
+    return DecodeEngine(params, 2, n_slots=2, max_len=16, serve_dtype=None)
+
+
+def test_api_generate_post_and_serve_stats(server, lm_engine):
+    """ISSUE 10: POST /api/generate submits through the decode engine;
+    GET /api/serve snapshots scheduler stats."""
+    server.attach_engine(lm_engine)
+    status, body = _post(server, "/api/generate",
+                         json.dumps({"prompt": [1, 2, 3],
+                                     "max_new_tokens": 4}).encode())
+    assert status == 200
+    out = json.loads(body)
+    assert len(out["tokens"]) == out["n"] == 4
+    assert out["prompt_len"] == 3
+    assert all(0 <= t < 31 for t in out["tokens"])
+
+    status, body = _get(server, "/api/serve")
+    assert status == 200
+    stats = json.loads(body)
+    assert stats["slots"] == 2
+    assert stats["tokens_total"] == 4
+    assert stats["requests_total"] == 1
+    assert stats["queue_depth"] == 0
+
+
+def test_api_generate_without_engine_404(server):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server, "/api/generate", b"{}")
+    assert e.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(server, "/api/serve")
+    assert e.value.code == 404
+
+
+def test_post_error_handling(server, lm_engine):
+    """ISSUE 10 satellite: do_POST's content-length/JSON error handling —
+    each bad request gets a specific 4xx, never a hang or a 500."""
+    server.attach_engine(lm_engine)
+    # invalid JSON → 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server, "/api/generate", b"{not json")
+    assert e.value.code == 400
+    # non-object body → 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server, "/api/generate", b"[1,2]")
+    assert e.value.code == 400
+    # missing/invalid prompt → 400
+    for bad in ({}, {"prompt": []}, {"prompt": "abc"},
+                {"prompt": [1, "x"]}, {"prompt": [True]}):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(server, "/api/generate", json.dumps(bad).encode())
+        assert e.value.code == 400, bad
+    # engine-side validation (token id out of vocab) → 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server, "/api/generate",
+              json.dumps({"prompt": [500]}).encode())
+    assert e.value.code == 400
+    # bad knob types → 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server, "/api/generate",
+              json.dumps({"prompt": [1], "max_new_tokens": "many"}).encode())
+    assert e.value.code == 400
+    # unknown POST route → 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server, "/api/nearest", b"{}")
+    assert e.value.code == 404
+
+
+def test_post_missing_content_length_411(server, lm_engine):
+    """A POST without Content-Length is answered 411, not read forever.
+    urllib always sets the header, so speak http.client directly."""
+    import http.client
+
+    server.attach_engine(lm_engine)
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
+    try:
+        conn.putrequest("POST", "/api/generate", skip_host=False)
+        conn.putheader("Content-Type", "application/json")
+        conn.endheaders()  # no Content-Length, no body
+        resp = conn.getresponse()
+        assert resp.status == 411
+        assert b"Content-Length" in resp.read()
+    finally:
+        conn.close()
+
+
+def test_api_generate_concurrent_requests_share_slots(server, lm_engine):
+    """Two handler threads generating concurrently ride the continuous-
+    batching loop (engine background thread) and both complete."""
+    import threading
+
+    lm_engine.start()
+    try:
+        server.attach_engine(lm_engine)
+        results = [None, None]
+
+        def fire(i):
+            _, body = _post(server, "/api/generate",
+                            json.dumps({"prompt": [1 + i, 2],
+                                        "max_new_tokens": 3}).encode())
+            results[i] = json.loads(body)
+
+        ts = [threading.Thread(target=fire, args=(i,)) for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        assert all(r is not None and r["n"] == 3 for r in results)
+    finally:
+        lm_engine.stop()
+
+
 def test_api_trace_endpoint(server, tmp_path):
     """ISSUE 7: /api/trace serves the attached tracer's flight-recorder
     ring — open spans with elapsed durations + recent ended spans — and

@@ -27,6 +27,12 @@ bytes`` / ``<stage>_profile_flops`` rows. Peak-memory and collective-byte
 rows are LOWER-IS-BETTER: ``--fail-on-regression`` also trips when one of
 them GROWS past the threshold — a PR fattening the compiled step's
 footprint fails the gate before it ever runs on a chip.
+
+ISSUE 10: stage details carrying a ``latency`` block (the serving bench's
+``serve_detail.latency`` — p50/p95/mean milliseconds under the open-loop
+traffic generator) contribute ``<stage>_latency_{p50,p95,mean}_ms`` rows,
+also LOWER-IS-BETTER — serving-latency growth past the threshold trips
+``--fail-on-regression`` exactly like a throughput drop.
 """
 
 from __future__ import annotations
@@ -45,9 +51,11 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _METRIC_RE = re.compile(
     r"_(?:per_sec|per_chip|mfu|vs_cpu|vs_single|vs_densecore|vs_baseline|"
     r"blocking_vs_background|overhead_pct)$")
-# profile-blob metrics where an INCREASE is the regression (ISSUE 9)
+# metrics where an INCREASE is the regression (ISSUE 9 footprint rows,
+# ISSUE 10 serving-latency rows)
 _LOWER_IS_BETTER_RE = re.compile(
-    r"_profile_(?:peak_bytes|collective_bytes)$")
+    r"_profile_(?:peak_bytes|collective_bytes)$"
+    r"|_latency_(?:p50|p95|mean)_ms$")
 # recovery regex for a truncated tail: top-level "key": number pairs
 _TAIL_PAIR_RE = re.compile(
     r'"([a-z0-9_]+)":\s*(-?[0-9]+(?:\.[0-9]+)?(?:[eE][-+]?[0-9]+)?)')
@@ -89,6 +97,27 @@ def _profile_metrics(detail: Dict) -> Dict[str, float]:
     return out
 
 
+def _latency_metrics(detail: Dict) -> Dict[str, float]:
+    """Serving-latency rows from stage details carrying a ``latency``
+    block (ISSUE 10): ``<stage>_detail.latency.{p50_ms,p95_ms,mean_ms}``
+    → ``<stage>_latency_{p50,p95,mean}_ms`` — tracked LOWER-IS-BETTER."""
+    out: Dict[str, float] = {}
+    for key, val in detail.items():
+        if not key.endswith("_detail") or not isinstance(val, dict):
+            continue
+        lat = val.get("latency")
+        if not isinstance(lat, dict):
+            continue
+        stage = key[: -len("_detail")]
+        for src, metric in (("p50_ms", "latency_p50_ms"),
+                            ("p95_ms", "latency_p95_ms"),
+                            ("mean_ms", "latency_mean_ms")):
+            v = lat.get(src)
+            if isinstance(v, (int, float)):
+                out[f"{stage}_{metric}"] = float(v)
+    return out
+
+
 def load_rounds(bench_dir: str) -> List[Dict]:
     """One record per BENCH_r*.json: {round, source, metrics, headline}."""
     rounds = []
@@ -110,6 +139,7 @@ def load_rounds(bench_dir: str) -> List[Dict]:
             metrics = {k: float(v) for k, v in detail.items()
                        if _is_metric_key(k) and isinstance(v, (int, float))}
             metrics.update(_profile_metrics(detail))
+            metrics.update(_latency_metrics(detail))
             rounds.append({"round": int(m.group(1)), "source": "parsed",
                            "metrics": metrics,
                            "headline": parsed.get("value")})
