@@ -1525,6 +1525,30 @@ def measure_serve() -> float:
     report8 = run_open_loop(engine8, prompts[:max(n_req // 2, 2)],
                             rate_rps=rate, max_new_tokens=max_new)
 
+    # ---- lockwatch overhead twin (ISSUE 11): the SAME bf16 open-loop run
+    # with the runtime lock-order watchdog armed — the engine's scheduler
+    # lock, the registry under it, and the condition handoff all become
+    # watched primitives. Budget: <5% tokens/s cost (asserted in
+    # test_bench_smoke); the detail also carries the per-lock hold/wait
+    # stats and the observed lock-order graph, cycle-free by construction.
+    from deeplearning4j_tpu.utils import lockwatch
+
+    lockwatch.reset()
+    lockwatch.enable(raise_on_cycle=True)
+    try:
+        engine_w = DecodeEngine(params, heads, n_slots=slots,
+                                max_len=max_len, serve_dtype="bf16")
+        warm(engine_w)
+        report_w = run_open_loop(engine_w, prompts, rate_rps=rate,
+                                 max_new_tokens=max_new)
+        watch = lockwatch.summary()
+        watch_rec = lockwatch.metrics_record()
+    finally:
+        lockwatch.disable()
+        lockwatch.reset()
+    lockwatch_overhead_pct = round(
+        (1.0 - report_w.tokens_per_sec / report.tokens_per_sec) * 100.0, 2)
+
     detail = {
         "slots": slots, "max_len": max_len, "n_requests": n_req,
         "max_new_tokens": max_new, "offered_rps": rate,
@@ -1552,6 +1576,15 @@ def measure_serve() -> float:
             "weight_bytes": engine8.weight_bytes,
             "weight_bytes_vs_bf16": round(
                 engine8.weight_bytes / max(engine.weight_bytes, 1), 3),
+        },
+        "lockwatch": {
+            "overhead_pct": lockwatch_overhead_pct,
+            "tokens_per_sec_watched": round(report_w.tokens_per_sec, 1),
+            "cycles": watch["cycles"],
+            "watchdog_dumps": watch["watchdog_dumps"],
+            "graph": watch["graph"],
+            "engine_lock": watch["locks"].get("serve.engine", {}),
+            "metrics": watch_rec,
         },
     }
     print("STAGE_DETAIL " + json.dumps(detail), flush=True)

@@ -80,7 +80,7 @@ def _get_lib() -> Optional[ctypes.CDLL]:
         if not os.path.exists(_SO_PATH) and not _build_attempted:
             _build_attempted = True
             try:
-                subprocess.run(
+                subprocess.run(  # graftlint: allow[blocking-under-lock] build-once seam: the lock must serialize the first-use make (bounded by timeout=120) so N threads never race the compiler
                     ["make", "-C", _NATIVE_DIR],
                     check=True, capture_output=True, timeout=120,
                 )

@@ -103,7 +103,8 @@ class DistributedWorker:
                 t.increment(f"rounds.{self.worker_id}")
         finally:
             stop.set()
-            self.tracker.close()
+            hb_thread.join(timeout=10)  # deterministic shutdown: the loop
+            self.tracker.close()        # wakes from stop.wait immediately
 
 
 class DistributedMaster:

@@ -546,6 +546,8 @@ class ElasticWorker:
             raise
         finally:
             stop.set()
+            if hb.ident is not None:  # started: wait for its last RPC so
+                hb.join(timeout=10)   # teardown never races hb.close()
             if self.tracker is not None:
                 self.tracker.close()
 

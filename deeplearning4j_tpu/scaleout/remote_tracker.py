@@ -63,6 +63,7 @@ from deeplearning4j_tpu.scaleout.statetracker import (
     StateTracker,
 )
 from deeplearning4j_tpu.telemetry import trace as _trace
+from deeplearning4j_tpu.utils.lockwatch import make_lock
 
 _HDR = struct.Struct(">I")
 _MAX_FRAME = 1 << 30
@@ -206,8 +207,9 @@ class StateTrackerServer:
         return f"{self.host}:{self.port}"
 
     def shutdown(self) -> None:
-        self._server.shutdown()
-        self._server.server_close()
+        self._server.shutdown()  # stops serve_forever; established handler
+        self._server.server_close()  # sockets drain on their own threads
+        self._thread.join(timeout=10)
 
     def __enter__(self):
         return self
@@ -243,7 +245,7 @@ class StateTrackerClient(StateTracker):
 
             registry = default_registry()
         self._registry = registry
-        self._lock = threading.Lock()
+        self._lock = make_lock("tracker.client")  # lockwatch seam
         self._sock: Optional[socket.socket] = None
         # version bookkeeping for clear_updates(expected) — see module doc
         self._snapshot_versions: Dict[int, Dict[str, int]] = {}
@@ -309,6 +311,7 @@ class StateTrackerClient(StateTracker):
                                        error=repr(last_exc))
                     delay = min(self._max_backoff_s,
                                 self._backoff_s * (2 ** (attempt - 1)))
+                    # graftlint: allow[blocking-under-lock] deliberate: the request lock IS the retry slot — releasing it mid-backoff would interleave another thread's frames onto the resyncing socket
                     time.sleep(delay * (0.5 + random.random() / 2))
                 try:
                     ok, result = self._roundtrip(method, args, kwargs, span)

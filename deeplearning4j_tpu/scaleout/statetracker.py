@@ -12,6 +12,8 @@ process, many threads, shared tracker).
 from __future__ import annotations
 
 import threading
+
+from deeplearning4j_tpu.utils.lockwatch import make_rlock
 from collections import defaultdict
 from typing import Any, Dict, List, Optional
 
@@ -70,7 +72,7 @@ class InMemoryStateTracker(StateTracker):
 
     def __init__(self, metrics_registry=None):
         self._registry = metrics_registry
-        self._lock = threading.RLock()
+        self._lock = make_rlock("tracker.state")  # lockwatch seam
         self._workers: List[str] = []
         self._jobs: Dict[str, Job] = {}
         self._updates: Dict[str, Job] = {}

@@ -59,6 +59,7 @@ from deeplearning4j_tpu.serve.quant import (
     params_nbytes,
     prepare_serve_params,
 )
+from deeplearning4j_tpu.utils.lockwatch import make_condition, make_rlock
 
 _UNSET = object()
 
@@ -137,8 +138,10 @@ class DecodeEngine:
                                           params_transform=dequantize_tree)
         self._buckets = self._make_buckets(min_bucket)
         self._key = jax.random.PRNGKey(seed)
-        self._lock = threading.RLock()
-        self._work = threading.Condition(self._lock)
+        # the lockwatch seam (ISSUE 11): plain primitives unless the
+        # watch is armed (lockwatch fixture / DL4J_TPU_LOCKWATCH=1)
+        self._lock = make_rlock("serve.engine")
+        self._work = make_condition(self._lock, name="serve.engine")
         self._queue: List[ServeRequest] = []
         self._slots: List[Optional[ServeRequest]] = [None] * self.n_slots
         # host mirrors of the decode step's per-slot inputs
@@ -264,7 +267,7 @@ class DecodeEngine:
             self.params, self._cache, padded, n - 1, slot,
             np.float32(req.temperature), self._key, self._step_idx)
         self._step_idx += 1
-        tok = int(np.asarray(tok))  # sync: the prefill dispatch is fenced
+        tok = int(np.asarray(tok))  # graftlint: allow[blocking-under-lock] deliberate: the scheduler lock IS the serialization — slot state may only change together with the fenced prefill result
         now = time.perf_counter()
         self.registry.histogram("serve_prefill_ms").observe(
             (now - t0) * 1000.0)
@@ -337,7 +340,7 @@ class DecodeEngine:
                 self.params, self._cache, self._tokens, self._positions,
                 self._temps, self._key, self._step_idx)
             self._step_idx += 1
-            toks = np.asarray(toks)  # sync: fences the decode dispatch
+            toks = np.asarray(toks)  # graftlint: allow[blocking-under-lock] deliberate: retirement must see the fenced decode tokens; submit() blocks here only between decode steps
             now = time.perf_counter()
             self.registry.histogram("serve_decode_step_ms").observe(
                 (now - t0) * 1000.0)
@@ -402,12 +405,15 @@ class DecodeEngine:
             self.step()
 
     def stop(self) -> None:
+        # swap the handle under the lock (two concurrent stop()s must not
+        # both join-then-None it; generate() reads _thread unlocked), join
+        # outside it — the loop needs the lock to observe _running
         with self._work:
             self._running = False
             self._work.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=10)
-            self._thread = None
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=10)
 
     # -------------------------------------------------------------- stats ----
     def stats(self) -> dict:

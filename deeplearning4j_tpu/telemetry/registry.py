@@ -45,6 +45,8 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from deeplearning4j_tpu.utils.lockwatch import make_rlock
+
 # per-iteration wall-clock style measurements land in milliseconds
 DEFAULT_BUCKETS: Tuple[float, ...] = (
     1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0,
@@ -155,7 +157,10 @@ class MetricsRegistry:
     """Get-or-create instrument store keyed by (name, sorted labels)."""
 
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        # lockwatch seam (ISSUE 11): the get-or-create map lock is the
+        # one every control-plane thread crosses; instrument locks stay
+        # plain (hot path, self-contained critical sections)
+        self._lock = make_rlock("telemetry.registry")
         self._counters: Dict[Tuple, Counter] = {}
         self._gauges: Dict[Tuple, Gauge] = {}
         self._histograms: Dict[Tuple, Histogram] = {}

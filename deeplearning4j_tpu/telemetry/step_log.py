@@ -174,4 +174,15 @@ def summarize_step_log(records: List[Dict]) -> Dict:
             round(sum(l[e] for l in loads) / n, 4)
             for e in range(len(loads[0]))
         ]
+    # lockwatch hold/contention metrics (ISSUE 11): records carrying
+    # ``lockwatch_*`` keys (utils.lockwatch.metrics_record) surface as
+    # one block — values are cumulative/max, so the latest wins. Absent
+    # keys mean the watch was off; the block is simply omitted.
+    lockwatch: Dict = {}
+    for r in records:
+        for k, v in r.items():
+            if k.startswith("lockwatch_") and isinstance(v, (int, float)):
+                lockwatch[k] = v
+    if lockwatch:
+        out["lockwatch"] = lockwatch
     return out

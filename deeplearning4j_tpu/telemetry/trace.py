@@ -51,6 +51,8 @@ import os
 import signal
 import sys
 import threading
+
+from deeplearning4j_tpu.utils.lockwatch import make_lock
 import time
 import uuid
 from collections import deque
@@ -188,7 +190,7 @@ class Tracer:
                 trace_dir, f"flightrec_{safe}.json")
         self.path = path
         self.flight_path = flight_path
-        self._lock = threading.Lock()
+        self._lock = make_lock("telemetry.trace")  # lockwatch seam
         self._fh = open(path, "a", buffering=1) if path else None
         self._ring: deque = deque(maxlen=max(1, int(ring)))
         self._open: Dict[str, Span] = {}

@@ -65,6 +65,8 @@ from __future__ import annotations
 import json
 import re
 import threading
+
+from deeplearning4j_tpu.utils.lockwatch import make_lock
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
@@ -417,7 +419,7 @@ class ProfileStore:
     (PR 2) serves them with zero extra ceremony. Thread-safe."""
 
     def __init__(self, registry=None):
-        self._lock = threading.Lock()
+        self._lock = make_lock("profile.store")  # lockwatch seam
         self._profiles: Dict[str, Dict] = {}
         self._registry = registry
 
@@ -543,7 +545,7 @@ class MemoryWatermarkSampler:
 
             registry = default_registry()
         self._registry = registry
-        self._lock = threading.Lock()
+        self._lock = make_lock("profile.memwatch")  # lockwatch seam
         self._watermarks: Dict[str, int] = {}
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()

@@ -382,4 +382,9 @@ class UiServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
-            self._thread = None
+            if self._thread is not None:
+                # shutdown() returns once serve_forever exits — join is
+                # deterministic, and without it interpreter teardown races
+                # the server thread's last writes (the PR 10 flake shape)
+                self._thread.join(timeout=10)
+                self._thread = None

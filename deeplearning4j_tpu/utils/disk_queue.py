@@ -24,6 +24,7 @@ class DiskBasedQueue:
         with self._lock:
             path = os.path.join(self._dir, f"item-{self._seq:012d}.pkl")
             self._seq += 1
+            # graftlint: allow[blocking-under-lock] deliberate: disk IO IS this queue's critical section — seq/file/deque must commit atomically (ref DiskBasedQueue semantics)
             with open(path, "wb") as f:
                 pickle.dump(item, f)
             self._order.append(path)
@@ -34,6 +35,7 @@ class DiskBasedQueue:
             if not self._order:
                 return None
             path = self._order.popleft()
+            # graftlint: allow[blocking-under-lock] deliberate: the read+unlink must be atomic with the dequeue or a concurrent peek() reads a vanishing file
             with open(path, "rb") as f:
                 item = pickle.load(f)
             os.unlink(path)
@@ -44,6 +46,7 @@ class DiskBasedQueue:
         with self._lock:
             if not self._order:
                 return None
+            # graftlint: allow[blocking-under-lock] deliberate: reading the head under the lock is the documented guard against a concurrent poll() unlinking it
             with open(self._order[0], "rb") as f:
                 return pickle.load(f)
 

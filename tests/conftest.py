@@ -18,6 +18,28 @@ set_host_device_count(8)
 
 
 @pytest.fixture
+def lockwatch():
+    """The utils.lockwatch runtime lock-order watchdog, armed for the
+    test: every lock created through the seam (DecodeEngine scheduler,
+    AsyncCheckpointer error lock, tracker client/state, registry, tracer,
+    profile store/sampler) becomes a watched primitive — acquisition
+    order feeds the cycle detector (raise armed: an order inversion fails
+    the test at the acquire, not as a hang), wait/hold land in
+    ``lockwatch_*`` registry metrics, and an acquire blocked past the
+    watchdog threshold dumps all thread stacks through the flight
+    recorder. Yields the module; ``lockwatch.summary()`` for assertions."""
+    from deeplearning4j_tpu.utils import lockwatch as lw
+
+    lw.reset()
+    lw.enable(raise_on_cycle=True, watchdog_s=20.0)
+    try:
+        yield lw
+    finally:
+        lw.disable()
+        lw.reset()
+
+
+@pytest.fixture
 def retrace_budget():
     """The utils.retrace_guard context manager as a fixture: pin a region's
     XLA compile budget with ``with retrace_budget(0, label="..."): ...`` —

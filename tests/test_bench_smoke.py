@@ -405,14 +405,24 @@ def test_bench_serve_stage_on_cpu():
     assert sd["naive_tokens_per_sec"] > 0
     assert sd["occupancy_mean"] > 0
     assert sd["serve_dtype"] == "bf16"
+    # lockwatch twin (ISSUE 11): the watched run stays cycle-free and
+    # inside the <5% tokens/s budget (shared-CPU noise: one retry below
+    # rides the serve_vs_naive retry)
+    watch = sd["lockwatch"]
+    assert watch["cycles"] == 0 and watch["watchdog_dumps"] == 0
+    assert watch["engine_lock"].get("acquires", 0) > 0
+    assert watch["metrics"].get("lockwatch_serve_engine_acquires", 0) > 0
     # int8 A/B twin: decodes, and the at-rest weights really shrank
     assert sd["int8"]["tokens_per_sec"] > 0
     assert sd["int8"]["weight_bytes"] < sd["weight_bytes"]
     assert sd["int8"]["weight_bytes_vs_bf16"] < 1.0
-    # the acceptance ratio: continuous batching beats recompute-per-token
-    if sd["serve_vs_naive"] <= 1.0:  # noise-floor retry, see docstring
+    # the acceptance ratios: continuous batching beats recompute-per-token
+    # AND the armed watchdog costs <5% tokens/s; one shared noise retry
+    if (sd["serve_vs_naive"] <= 1.0
+            or sd["lockwatch"]["overhead_pct"] >= 5.0):
         sd = run_stage()["serve_detail"]
     assert sd["serve_vs_naive"] > 1.0, sd
+    assert sd["lockwatch"]["overhead_pct"] < 5.0, sd["lockwatch"]
 
 
 # ------------------------------------------------ stage-coverage meta-test ----

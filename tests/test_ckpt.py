@@ -435,3 +435,68 @@ class TestFaultRobustness:
         assert reg.counter("ckpt_async_saves_total").value == 3
         assert reg.counter("ckpt_saves_total").value == 3
         ck.close()
+
+
+class TestAsyncCheckpointerStress:
+    def test_concurrent_save_flush_close_under_lockwatch(self, tmp_path,
+                                                         lockwatch):
+        """ISSUE 11 stress: saver threads racing flush() against the
+        double-buffered (max_pending=2) backpressure path, lock-order
+        cycle detection armed. Every enqueued save must commit exactly
+        once, flush must never deadlock against a full queue, and the
+        queue's error lock shows real cross-thread traffic."""
+        import threading
+        import time
+
+        from deeplearning4j_tpu.scaleout.ckpt import (
+            AsyncCheckpointer,
+            Checkpointer,
+        )
+
+        reg = MetricsRegistry()
+        ck = AsyncCheckpointer(
+            Checkpointer(str(tmp_path), keep_last=100, registry=reg),
+            max_pending=2)
+        n_savers, per_saver = 3, 6
+        errors = []
+
+        def saver(i):
+            try:
+                for j in range(per_saver):
+                    step = i * 1000 + j
+                    ck.save(step, {"x": jnp.full((32,), float(step))},
+                            meta={"step": step})
+            except BaseException as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        def flusher():
+            try:
+                for _ in range(4):
+                    ck.flush()
+                    time.sleep(0.005)
+            except BaseException as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        threads = [threading.Thread(target=saver, args=(i,))
+                   for i in range(n_savers)]
+        threads.append(threading.Thread(target=flusher))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads), "stress hung"
+        ck.close()  # final drain + writer join
+        assert not errors, errors
+        total = n_savers * per_saver
+        assert reg.counter("ckpt_async_saves_total").value == total
+        assert reg.counter("ckpt_async_failures_total").value == 0
+        # every save commit is restorable at its exact bytes
+        steps = ck.step_dirs()
+        assert len(steps) == total
+        state, step, meta = ck.restore({"x": jnp.zeros(32)})
+        np.testing.assert_array_equal(np.asarray(state["x"]),
+                                      np.full((32,), float(step)))
+        watch = lockwatch.summary()
+        assert watch["cycles"] == 0 and watch["watchdog_dumps"] == 0
+        assert watch["locks"].get("ckpt.async.error", {}).get(
+            "acquires", 0) > 0, "error lock was not watched"

@@ -102,7 +102,7 @@ def _worker_summary(out: str, worker_id: str) -> dict:
 
 # ---------------------------------------------------- kill -9 mid-round ----
 
-def test_elastic_kill_recover_smoke(tmp_path):
+def test_elastic_kill_recover_smoke(tmp_path, lockwatch):
     """Tier-1 smoke for acceptance (a): one of two REAL worker processes
     hard-exits mid-round (before publishing — its delta is unsynced), the
     master deregisters it on heartbeat staleness and commits every round
@@ -113,7 +113,13 @@ def test_elastic_kill_recover_smoke(tmp_path):
     and the kill -9 must leave forensics, not silence — the victim's
     flight-recorder dump (written ahead at registration), its UNCLOSED
     round-0 spans on disk, and a trace_report timeline that merges all
-    three processes with barrier-wait attribution."""
+    three processes with barrier-wait attribution.
+
+    ISSUE 11 rides it too (armed ``lockwatch``): the master-side control
+    plane — embedded tracker state lock, registry, tracer — runs on
+    watched primitives with cycle detection raising, so a lock-order
+    inversion between the master's heartbeat scan and a handler thread
+    fails loudly here instead of deadlocking a fleet."""
     blob = f"file://{tmp_path / 'blob'}"
     trace_dir = str(tmp_path / "trace")
     prev = trace_mod.set_tracer(trace_mod.Tracer(
@@ -137,6 +143,10 @@ def test_elastic_kill_recover_smoke(tmp_path):
             outs = _finish(procs, master)
     finally:
         trace_mod.set_tracer(prev)
+    watch = lockwatch.summary()
+    assert watch["cycles"] == 0 and watch["watchdog_dumps"] == 0
+    assert watch["locks"].get("tracker.state", {}).get("acquires", 0) > 0, \
+        "the embedded tracker's state lock was not watched"
     assert procs[1].returncode == 23, outs[1][1][-500:]  # the os._exit mark
     assert master.tracker.count("workers_failed") == 1
     assert "victim" not in master.tracker.workers()

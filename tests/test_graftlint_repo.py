@@ -75,3 +75,53 @@ def test_cli_detects_a_planted_finding(tmp_path):
         capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
     assert out.returncode == 1
     assert "jit-host-sync" in out.stdout
+
+
+def test_cli_rule_filter_runs_one_rule(tmp_path):
+    """ISSUE 11 triage mode: --rule restricts the run to one rule and
+    does not report stale entries for the rules it skipped."""
+    bad = tmp_path / "planted.py"
+    bad.write_text(
+        "import threading\n\n"
+        "class S:\n"
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=self._run)\n"
+        "        self._t.start()\n\n"
+        "    def _run(self):\n"
+        "        x = float(1)\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "lint_gate.py"),
+         "--rule", "unjoined-thread", "--no-baseline", str(bad)],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
+    assert out.returncode == 1
+    assert "unjoined-thread" in out.stdout
+    # the repo gate restricted to one rule is clean AND quiet about the
+    # other rules' baseline entries (no stale noise in triage mode)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "lint_gate.py"),
+         "--rule", "unjoined-thread", "--json"],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["findings"] == []
+    assert payload["stale_baseline_entries"] == []
+
+
+def test_cli_rule_filter_rejects_unknown_rule():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "lint_gate.py"),
+         "--rule", "not-a-rule"],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
+    assert out.returncode == 2
+    assert "unknown rule" in out.stderr
+
+
+def test_concurrency_rules_are_registered():
+    """The five ISSUE 11 rules ride the same registry/gate as the JAX
+    rules — DEFAULT_TARGETS sweeps them over the whole repo in tier-1."""
+    from tools.graftlint import RULES
+
+    for rule in ("unguarded-shared-state", "lock-order",
+                 "blocking-under-lock", "unjoined-thread",
+                 "condition-wait-no-predicate"):
+        assert rule in RULES, rule

@@ -309,3 +309,459 @@ def test_inline_allow_requires_reason():
 
 def test_parse_error_is_a_finding_not_a_crash():
     assert _rules_hit("def broken(:\n") == {"parse-error"}
+
+
+# ============================================================================
+# Concurrency rules (ISSUE 11) — bad+clean golden fixtures per rule, kept in
+# module-level dicts so the meta-test below can pin that EVERY registered
+# rule ships fixtures (a future rule cannot land unpinned).
+
+BAD_FIXTURES = {
+    "jit-host-sync": """
+        import jax
+
+        @jax.jit
+        def step(params, x):
+            return float((params * x).sum())
+    """,
+    "untimed-dispatch": """
+        import time
+
+        def bench(step, params, x):
+            t0 = time.perf_counter()
+            params, loss = step(params, x)
+            return time.perf_counter() - t0
+    """,
+    "prng-reuse": """
+        import jax
+
+        def init(key):
+            w1 = jax.random.normal(key, (4, 4))
+            w2 = jax.random.normal(key, (4, 4))
+            return w1, w2
+    """,
+    "stray-debug": """
+        import jax
+
+        @jax.jit
+        def step(x):
+            print("x", x)
+            return x
+    """,
+    "nondet-pytree": """
+        def build(names, init):
+            return {n: init(n) for n in set(names)}
+    """,
+    "env-read-in-trace": """
+        import os
+
+        def configure():
+            return os.environ.get("SOME_RANDOM_KNOB")
+    """,
+    "missing-donate": """
+        import jax
+
+        @jax.jit
+        def train_step(params, x):
+            return params - 0.1 * x
+    """,
+    "unguarded-shared-state": """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+                self._thread = threading.Thread(target=self._loop)
+
+            def start(self):
+                self._thread.start()
+
+            def _loop(self):
+                while True:
+                    self.count += 1       # thread-side write, no lock
+
+            def snapshot(self):
+                with self._lock:
+                    return self.count     # a lock the writer never takes
+
+            def stop(self):
+                self._thread.join()
+    """,
+    "lock-order": """
+        import threading
+
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def one():
+            with a:
+                with b:
+                    pass
+
+        def two():
+            with b:
+                with a:                   # reversed: deadlock risk
+                    pass
+    """,
+    "blocking-under-lock": """
+        import threading
+        import time
+
+        class Poller:
+            def __init__(self, sock):
+                self._lock = threading.Lock()
+                self._sock = sock
+
+            def poll(self):
+                with self._lock:
+                    return self._sock.recv(1024)   # blocks all contenders
+
+            def backoff(self):
+                with self._lock:
+                    time.sleep(1.0)
+    """,
+    "unjoined-thread": """
+        import threading
+
+        class Sampler:
+            def start(self):
+                self._thread = threading.Thread(target=self._run,
+                                                daemon=True)
+                self._thread.start()
+
+            def _run(self):
+                pass
+
+            def stop(self):
+                pass                       # no join: teardown races _run
+    """,
+    "condition-wait-no-predicate": """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+                self._ready = threading.Event()
+                self.item = None
+
+            def get(self):
+                with self._cond:
+                    self._cond.wait(1.0)   # spurious wakeup -> None
+                    return self.item
+
+            def get_event(self):
+                self._ready.wait(0.5)      # result discarded
+                return self.item
+    """,
+}
+
+CLEAN_FIXTURES = {
+    "jit-host-sync": """
+        import jax
+
+        @jax.jit
+        def step(params, x):
+            return (params * x).sum()
+    """,
+    "untimed-dispatch": """
+        import time
+        import jax
+
+        def bench(step, params, x):
+            t0 = time.perf_counter()
+            params, loss = step(params, x)
+            jax.block_until_ready(loss)
+            return time.perf_counter() - t0
+    """,
+    "prng-reuse": """
+        import jax
+
+        def init(key):
+            k1, k2 = jax.random.split(key)
+            return jax.random.normal(k1, (4, 4)), jax.random.normal(k2, (4, 4))
+    """,
+    "stray-debug": """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x
+
+        def fit(x):
+            y = step(x)
+            print("y", float(y))
+            return y
+    """,
+    "nondet-pytree": """
+        def build(names, init):
+            return {n: init(n) for n in sorted(set(names))}
+    """,
+    "env-read-in-trace": """
+        import os
+
+        def configure():
+            return os.environ.get("DL4J_TPU_SOME_KNOB")
+    """,
+    "missing-donate": """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def train_step(params, x):
+            return params - 0.1 * x
+    """,
+    "unguarded-shared-state": """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+                self._thread = threading.Thread(target=self._loop)
+
+            def start(self):
+                self._thread.start()
+
+            def _loop(self):
+                while True:
+                    with self._lock:
+                        self.count += 1
+
+            def snapshot(self):
+                with self._lock:
+                    return self.count
+
+            def stop(self):
+                self._thread.join()
+    """,
+    "lock-order": """
+        import threading
+
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def one():
+            with a:
+                with b:
+                    pass
+
+        def two():
+            with a:                        # same global order everywhere
+                with b:
+                    pass
+    """,
+    "blocking-under-lock": """
+        import threading
+
+        class Poller:
+            def __init__(self, sock):
+                self._lock = threading.Lock()
+                self._sock = sock
+                self._last = None
+
+            def poll(self):
+                data = self._sock.recv(1024)   # blocks OUTSIDE the lock
+                with self._lock:
+                    self._last = data
+                return data
+    """,
+    "unjoined-thread": """
+        import threading
+
+        class Sampler:
+            def start(self):
+                self._stop = threading.Event()
+                self._thread = threading.Thread(target=self._run,
+                                                daemon=True)
+                self._thread.start()
+
+            def _run(self):
+                while not self._stop.wait(0.1):
+                    pass
+
+            def stop(self):
+                self._stop.set()
+                self._thread.join(timeout=10)
+    """,
+    "condition-wait-no-predicate": """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+                self._ready = threading.Event()
+                self.item = None
+
+            def get(self):
+                with self._cond:
+                    while self.item is None:   # predicate re-checked
+                        self._cond.wait(1.0)
+                    return self.item
+
+            def get_event(self):
+                if not self._ready.wait(0.5):  # result checked
+                    raise TimeoutError
+                return self.item
+    """,
+}
+
+
+def _rule_params():
+    import pytest as _pytest
+
+    from tools.graftlint import RULES
+
+    return _pytest.mark.parametrize("rule", sorted(RULES))
+
+
+@_rule_params()
+def test_bad_fixture_trips_its_rule(rule):
+    assert rule in BAD_FIXTURES, f"no bad golden fixture for rule {rule!r}"
+    assert rule in _rules_hit(BAD_FIXTURES[rule]), (
+        f"the bad fixture for {rule!r} no longer trips it")
+
+
+@_rule_params()
+def test_clean_fixture_passes_its_rule(rule):
+    assert rule in CLEAN_FIXTURES, f"no clean golden fixture for {rule!r}"
+    assert rule not in _rules_hit(CLEAN_FIXTURES[rule]), (
+        f"the clean fixture for {rule!r} falsely trips it")
+
+
+def test_every_registered_rule_has_fixtures():
+    """The meta-pin: a rule cannot register without shipping bad+clean
+    goldens here — future rules land pinned or not at all."""
+    from tools.graftlint import RULES
+
+    assert set(BAD_FIXTURES) == set(RULES), (
+        f"BAD_FIXTURES out of sync with the registry: "
+        f"missing={set(RULES) - set(BAD_FIXTURES)}, "
+        f"orphaned={set(BAD_FIXTURES) - set(RULES)}")
+    assert set(CLEAN_FIXTURES) == set(RULES), (
+        f"CLEAN_FIXTURES out of sync with the registry: "
+        f"missing={set(RULES) - set(CLEAN_FIXTURES)}, "
+        f"orphaned={set(CLEAN_FIXTURES) - set(RULES)}")
+
+
+# ----------------------------------------- concurrency rule edge behavior ----
+
+def test_condition_alias_guards_shared_state():
+    """`Condition(self._lock)` IS the lock: guarding via the condition on
+    one side and the lock on the other shares one underlying mutex."""
+    src = """
+    import threading
+
+    class Engine:
+        def __init__(self):
+            self._lock = threading.RLock()
+            self._work = threading.Condition(self._lock)
+            self.queue = []
+            self._thread = threading.Thread(target=self._loop)
+
+        def start(self):
+            self._thread.start()
+
+        def submit(self, item):
+            with self._work:
+                self.queue.append(item)
+                self._work.notify_all()
+
+        def _loop(self):
+            with self._lock:
+                if self.queue:
+                    self.queue.pop(0)
+
+        def stop(self):
+            self._thread.join()
+    """
+    assert "unguarded-shared-state" not in _rules_hit(src)
+
+
+def test_lock_propagates_through_private_helpers():
+    """A helper only ever called under the lock inherits the guard — the
+    DecodeEngine._accept_token shape must not false-positive."""
+    src = """
+    import threading
+
+    class Engine:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.total = 0
+            self._thread = threading.Thread(target=self._loop)
+
+        def start(self):
+            self._thread.start()
+
+        def _bump(self):
+            self.total += 1            # guarded at every call site
+
+        def _loop(self):
+            with self._lock:
+                self._bump()
+
+        def read(self):
+            with self._lock:
+                return self.total
+
+        def stop(self):
+            self._thread.join()
+    """
+    assert "unguarded-shared-state" not in _rules_hit(src)
+
+
+def test_blocking_under_lock_allows_condition_wait():
+    src = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cond = threading.Condition(self._lock)
+            self.item = None
+
+        def get(self):
+            with self._cond:
+                while self.item is None:
+                    self._cond.wait(0.1)   # releases while waiting: fine
+                return self.item
+    """
+    assert "blocking-under-lock" not in _rules_hit(src)
+
+
+def test_unjoined_thread_join_via_local_swap():
+    """`t, self._thread = self._thread, None` then `t.join()` counts as a
+    join path (the DecodeEngine.stop shape)."""
+    src = """
+    import threading
+
+    class Engine:
+        def start(self):
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+        def _loop(self):
+            pass
+
+        def stop(self):
+            t, self._thread = self._thread, None
+            if t is not None:
+                t.join(timeout=10)
+    """
+    assert "unjoined-thread" not in _rules_hit(src)
+
+
+def test_unjoined_thread_joined_via_list_loop():
+    src = """
+    import threading
+
+    def fan_out(work):
+        threads = [threading.Thread(target=w) for w in work]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+    """
+    assert "unjoined-thread" not in _rules_hit(src)

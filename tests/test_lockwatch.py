@@ -1,0 +1,350 @@
+"""ISSUE 11 runtime half: the utils.lockwatch lock-order watchdog, and the
+thread-lifecycle audit — every server/loop shutdown path must join its
+threads deterministically (the class of defect the PR 10 tracker flake
+exposed; the graftlint ``unjoined-thread`` sweep found four more)."""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu.telemetry.registry import MetricsRegistry  # noqa: E402
+from deeplearning4j_tpu.utils import lockwatch as lw  # noqa: E402
+
+
+# ---------------------------------------------------------------- seam ----
+
+def test_seam_hands_out_plain_primitives_when_off():
+    assert not lw.enabled()
+    lock = lw.make_lock("off.lock")
+    assert type(lock) is type(threading.Lock())
+    rlock = lw.make_rlock("off.rlock")
+    assert type(rlock) is type(threading.RLock())
+    cond = lw.make_condition(name="off.cond")
+    assert isinstance(cond, threading.Condition)
+
+
+def test_seam_hands_out_watched_primitives_when_armed(lockwatch):
+    lock = lw.make_lock("on.lock")
+    assert isinstance(lock, lw.WatchedLock)
+    rlock = lw.make_rlock("on.rlock")
+    assert isinstance(rlock, lw.WatchedRLock)
+
+
+def test_env_var_arms_at_creation(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_LOCKWATCH", "1")
+    try:
+        lock = lw.make_lock("env.lock")
+        assert isinstance(lock, lw.WatchedLock)
+        assert lw.enabled()
+    finally:
+        lw.disable()
+        lw.reset()
+
+
+def test_disable_quiesces_existing_wrappers(lockwatch):
+    lock = lw.make_lock("quiesce.lock")
+    with lock:
+        pass
+    before = lw.summary()["locks"]["quiesce.lock"]["acquires"]
+    lw.disable()
+    with lock:  # still a working mutex, no recording
+        pass
+    lw.enable()
+    assert lw.summary()["locks"]["quiesce.lock"]["acquires"] == before
+
+
+# --------------------------------------------------------- order graph ----
+
+def test_cycle_raises_before_deadlocking(lockwatch):
+    a, b = lw.make_lock("order.a"), lw.make_lock("order.b")
+    with a:
+        with b:
+            pass
+    errs = []
+
+    def reversed_order():
+        try:
+            with b:
+                with a:
+                    pass
+        except lw.LockOrderViolation as exc:
+            errs.append(exc)
+
+    t = threading.Thread(target=reversed_order)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert len(errs) == 1 and "order.a" in str(errs[0])
+    assert lw.summary()["cycles"] == 1
+    assert lw.graph_snapshot()["order.a"] == ["order.b"]
+
+
+def test_consistent_order_never_flags(lockwatch):
+    a, b = lw.make_lock("ok.a"), lw.make_lock("ok.b")
+    for _ in range(5):
+        with a:
+            with b:
+                pass
+    assert lw.summary()["cycles"] == 0
+
+
+def test_cycle_counted_not_raised_when_disarmed():
+    lw.reset()
+    lw.enable(raise_on_cycle=False)
+    try:
+        a, b = lw.make_lock("soft.a"), lw.make_lock("soft.b")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:  # inversion: recorded, not raised
+                pass
+        assert lw.summary()["cycles"] == 1
+    finally:
+        lw.disable()
+        lw.reset()
+
+
+def test_rlock_reentry_is_not_an_edge(lockwatch):
+    r = lw.make_rlock("re.lock")
+    with r:
+        with r:  # reentrant: no self-edge, no second acquire record
+            pass
+    assert "re.lock" not in lw.graph_snapshot()
+    assert lw.summary()["locks"]["re.lock"]["acquires"] == 1
+
+
+# ------------------------------------------------- condition integration ----
+
+def test_condition_wait_hands_off_watched_lock(lockwatch):
+    r = lw.make_rlock("cv.lock")
+    cond = lw.make_condition(r, name="cv.lock")
+    items = []
+
+    def producer():
+        with cond:
+            items.append(1)
+            cond.notify_all()
+
+    got = []
+
+    def consumer():
+        with cond:
+            while not items:
+                cond.wait(0.05)
+            got.append(items[0])
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.05)
+    producer()
+    t.join(timeout=10)
+    assert got == [1]
+    assert lw.summary()["cycles"] == 0
+
+
+# ------------------------------------------------ metrics and watchdog ----
+
+def test_metrics_flow_through_registry():
+    reg = MetricsRegistry()
+    lw.reset()
+    lw.enable(registry=reg)
+    try:
+        lock = lw.make_lock("met.lock")
+        hold = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with lock:
+                hold.set()
+                release.wait(5)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        assert hold.wait(5)
+        t2_done = []
+
+        def contender():
+            with lock:
+                t2_done.append(1)
+
+        t2 = threading.Thread(target=contender)
+        t2.start()
+        time.sleep(0.05)
+        release.set()
+        t.join(timeout=10)
+        t2.join(timeout=10)
+        assert t2_done == [1]
+        labels = {"lock": "met.lock"}
+        assert reg.counter("lockwatch_acquires_total", labels).value >= 2
+        assert reg.counter("lockwatch_contended_total", labels).value >= 1
+        assert reg.histogram("lockwatch_wait_ms", labels).count >= 2
+        assert reg.histogram("lockwatch_hold_ms", labels).count >= 2
+        rec = lw.metrics_record()
+        assert rec["lockwatch_met_lock_acquires"] >= 2
+        assert rec["lockwatch_met_lock_contended"] >= 1
+        assert rec["lockwatch_met_lock_hold_ms_max"] > 0
+    finally:
+        lw.disable()
+        lw.reset()
+
+
+def test_timed_acquire_honors_timeout(lockwatch):
+    lock = lw.make_lock("timeout.lock")
+    release = threading.Event()
+
+    def holder():
+        with lock:
+            release.wait(5)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    time.sleep(0.05)
+    t0 = time.perf_counter()
+    assert lock.acquire(timeout=0.2) is False
+    assert time.perf_counter() - t0 < 2.0
+    release.set()
+    t.join(timeout=10)
+
+
+def test_watchdog_dumps_thread_stacks_through_flight_recorder(tmp_path):
+    from deeplearning4j_tpu.telemetry import trace as tr
+
+    lw.reset()
+    lw.enable(watchdog_s=0.2)
+    tracer = tr.Tracer("lockwatch-test", trace_dir=str(tmp_path),
+                       registry=MetricsRegistry())
+    prev = tr.set_tracer(tracer)
+    try:
+        lock = lw.make_lock("stuck.lock")
+        release = threading.Event()
+
+        def holder():
+            with lock:
+                release.wait(5)
+
+        t = threading.Thread(target=holder, name="the-holder")
+        t.start()
+        time.sleep(0.05)
+        assert lock.acquire(timeout=0.6) is False  # blocked past watchdog
+        release.set()
+        t.join(timeout=10)
+        assert lw.summary()["watchdog_dumps"] == 1
+        dump_path = os.path.join(str(tmp_path),
+                                 "flightrec_lockwatch-test.json")
+        assert os.path.exists(dump_path)
+        payload = json.load(open(dump_path))
+        assert payload["reason"] == "lockwatch_blocked"
+        extra = payload["extra"]
+        assert extra["lockwatch"]["lock"] == "stuck.lock"
+        stacks = extra["thread_stacks"]
+        assert any("the-holder" in k for k in stacks), list(stacks)
+    finally:
+        tr.set_tracer(prev)
+        lw.disable()
+        lw.reset()
+
+
+# ------------------------------------------- thread-lifecycle audit ----
+# Satellite: every server/loop shutdown path joins its threads. The
+# repeated open/close loops pin the fix for the graftlint sweep findings
+# (UiServer + tracker server never joined; engine stop raced _thread) —
+# a leaked thread shows up as a drifting active_count.
+
+def _stable_thread_count(fn, cycles=4):
+    """Run fn() (open+close one subsystem) repeatedly; the thread count
+    after each cycle must return to the baseline."""
+    fn()  # warm any lazily-started machinery
+    baseline = threading.active_count()
+    for _ in range(cycles):
+        fn()
+        deadline = time.time() + 5
+        while threading.active_count() > baseline and time.time() < deadline:
+            time.sleep(0.01)
+        assert threading.active_count() <= baseline, (
+            f"thread leak: {threading.active_count()} > {baseline} after "
+            f"close ({[t.name for t in threading.enumerate()]})")
+
+
+def test_ui_server_close_joins_its_thread():
+    from deeplearning4j_tpu.ui.server import UiServer
+
+    def cycle():
+        srv = UiServer()
+        srv.start(port=0)
+        srv.stop()
+
+    _stable_thread_count(cycle)
+
+
+def test_tracker_server_shutdown_joins_its_thread():
+    from deeplearning4j_tpu.scaleout.remote_tracker import (
+        StateTrackerClient,
+        StateTrackerServer,
+    )
+
+    def cycle():
+        server = StateTrackerServer()
+        client = StateTrackerClient(server.address,
+                                    registry=MetricsRegistry())
+        client.add_worker("w")
+        client.close()
+        server.shutdown()
+
+    _stable_thread_count(cycle)
+
+
+def test_memory_watermark_sampler_stop_joins():
+    from deeplearning4j_tpu.telemetry.xprofile import MemoryWatermarkSampler
+
+    def cycle():
+        with MemoryWatermarkSampler(registry=MetricsRegistry(),
+                                    interval_s=0.01):
+            time.sleep(0.03)
+
+    _stable_thread_count(cycle)
+
+
+def test_async_checkpointer_close_joins(tmp_path):
+    from deeplearning4j_tpu.scaleout.ckpt import (
+        AsyncCheckpointer,
+        Checkpointer,
+    )
+
+    idx = [0]
+
+    def cycle():
+        idx[0] += 1
+        root = tmp_path / f"ck{idx[0]}"
+        with AsyncCheckpointer(Checkpointer(str(root),
+                                            registry=MetricsRegistry())):
+            pass
+
+    _stable_thread_count(cycle)
+
+
+def test_engine_stop_is_idempotent_and_joins():
+    from deeplearning4j_tpu.models.transformer_lm import init_lm_params
+    from deeplearning4j_tpu.serve.engine import DecodeEngine
+
+    import jax
+
+    params = init_lm_params(jax.random.PRNGKey(0), 31, 8, 2, 2, 16,
+                            n_layers=1)
+    engine = DecodeEngine(params, 2, n_slots=2, max_len=16,
+                          serve_dtype=None, registry=MetricsRegistry())
+
+    def cycle():
+        engine.start()
+        engine.generate([1, 2, 3], max_new_tokens=2)
+        engine.stop()
+        engine.stop()  # second stop: no-op, no AttributeError, no hang
+
+    _stable_thread_count(cycle)

@@ -731,7 +731,10 @@ class TestRegistryConcurrency:
     locks must make increments exact and snapshots crash-free; the
     cross-PROCESS story is isolation by design (see registry.py doc)."""
 
-    def test_concurrent_increments_are_exact(self):
+    def test_concurrent_increments_are_exact(self, lockwatch):
+        # armed lockwatch (ISSUE 11): the registry's get-or-create lock is
+        # a watched primitive for the whole hammering — any lock-order
+        # inversion raises here instead of deadlocking a real run
         import threading
 
         reg = MetricsRegistry()
@@ -759,6 +762,10 @@ class TestRegistryConcurrency:
         assert h.count == total
         snap = h.snapshot()
         assert snap["buckets"][-1]["count"] == total  # +Inf is cumulative
+        watch = lockwatch.summary()
+        assert watch["locks"].get("telemetry.registry", {}).get(
+            "acquires", 0) > 0, "registry lock was not watched"
+        assert watch["cycles"] == 0
 
     def test_snapshot_safe_under_concurrent_writes(self):
         import threading
@@ -869,3 +876,53 @@ class TestNonfiniteReport:
         assert out.returncode == 0, out.stderr
         summary = json.loads(out.stdout)
         assert summary["nonfinite"] == {"loss": 2, "grad_norm": 1}
+
+
+class TestLockwatchReport:
+    """ISSUE 11: tools/telemetry_report.py surfaces lockwatch_* hold/
+    contention metrics as a table section — and stays silent when the
+    log carries none."""
+
+    def _run_report(self, path):
+        import subprocess
+        import sys as _sys
+
+        out = subprocess.run(
+            [_sys.executable,
+             os.path.join(REPO, "tools", "telemetry_report.py"), path],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        return out.stdout
+
+    def test_lockwatch_section_rendered(self, tmp_path):
+        from deeplearning4j_tpu.utils import lockwatch as lw
+
+        lw.reset()
+        lw.enable()
+        try:
+            lock = lw.make_lock("report.lock")
+            for _ in range(3):
+                with lock:
+                    pass
+            rec = lw.metrics_record()
+        finally:
+            lw.disable()
+            lw.reset()
+        assert rec["lockwatch_report_lock_acquires"] == 3
+        path = str(tmp_path / "steps.jsonl")
+        with StepLogWriter(path) as w:
+            w.write(0, loss=1.0)
+            w.write(1, loss=0.5, **rec)
+        summary = summarize_step_log(read_step_log(path))
+        assert summary["lockwatch"]["lockwatch_report_lock_acquires"] == 3
+        text = self._run_report(path)
+        assert "lockwatch (per watched lock)" in text
+        assert "report_lock" in text
+
+    def test_silent_without_lockwatch_metrics(self, tmp_path):
+        path = str(tmp_path / "steps.jsonl")
+        with StepLogWriter(path) as w:
+            w.write(0, loss=1.0)
+            w.write(1, loss=0.5)
+        assert "lockwatch" not in summarize_step_log(read_step_log(path))
+        assert "lockwatch (per watched lock)" not in self._run_report(path)

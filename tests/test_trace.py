@@ -156,7 +156,9 @@ class TestFlightRecorder:
 
 class TestTrackerPropagation:
     def test_rpc_span_links_client_and_server(self, tmp_path,
-                                              no_global_tracer):
+                                              no_global_tracer, lockwatch):
+        # armed lockwatch (ISSUE 11): tracer ring lock + tracker client
+        # request lock + server state lock are all watched across the RPC
         from deeplearning4j_tpu.scaleout.remote_tracker import (
             StateTrackerClient,
             StateTrackerServer,
@@ -186,6 +188,11 @@ class TestTrackerPropagation:
         serve = by_name["tracker.serve"][0]
         assert serve["parent_id"] == rpc["span_id"]
         assert serve["trace_id"] == rpc["trace_id"] == op.trace_id
+        watch = lockwatch.summary()
+        assert watch["cycles"] == 0
+        for name in ("telemetry.trace", "tracker.client", "tracker.state"):
+            assert watch["locks"].get(name, {}).get("acquires", 0) > 0, \
+                f"{name} lock was not watched across the RPC"
 
     def test_retry_recorded_as_event(self, tmp_path, no_global_tracer):
         import _dist_helpers

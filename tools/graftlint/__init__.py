@@ -31,3 +31,6 @@ from tools.graftlint.engine import (  # noqa: F401
     lint_source,
 )
 from tools.graftlint import rules as _rules  # noqa: F401  (registers RULES)
+from tools.graftlint import (  # noqa: F401  (registers concurrency RULES)
+    concurrency_rules as _concurrency_rules,
+)

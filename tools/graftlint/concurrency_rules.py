@@ -1,0 +1,413 @@
+"""Concurrency rule set (ISSUE 11): thread-safety for the host control
+plane.
+
+The compute path is single-controller SPMD, but the host side — decode
+scheduler, async checkpoint writer, elastic master, tracker server, UI —
+is exactly the concurrency-heavy actor runtime the reference built on
+scaleout-akka + Hazelcast, and it fails the same ways: shared attributes
+mutated off-lock, lock cycles, blocking syscalls under a lock, threads
+started with no shutdown path (the PR 10 tracker flake), and condition
+waits that trust a single wakeup. Each rule builds on the per-module
+:class:`tools.graftlint.threads.ThreadModel` (thread-entrypoint
+reachability, lock aliasing, call-graph lock propagation); the runtime
+half — true cross-module lock orders, hold times, contention — lives in
+``deeplearning4j_tpu/utils/lockwatch.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from tools.graftlint.engine import (
+    Finding,
+    ModuleContext,
+    dotted,
+    last_part,
+    register,
+)
+from tools.graftlint.threads import thread_model
+
+_SAFE_ATTR_KINDS = {"lock", "condition", "threadsafe"}
+_PRE_START_METHODS = {"__init__", "__new__", "__del__", "__repr__"}
+
+
+def _finding(ctx: ModuleContext, rule: str, node: ast.AST, message: str,
+             hint: str) -> Finding:
+    return Finding(rule, ctx.path, node.lineno, message, hint,
+                   ctx.snippet(node.lineno))
+
+
+# ----------------------------------------------------- unguarded-shared-state ----
+
+@register("unguarded-shared-state")
+def unguarded_shared_state(ctx: ModuleContext) -> Iterable[Finding]:
+    """In a class that spawns threads, a ``self.*`` attribute written on
+    the thread side (an entrypoint or anything it reaches) and also
+    touched on the main side, where some pair of cross-side accesses holds
+    no common lock. Lock/Condition/Event/Queue-valued attributes are
+    exempt (the object IS the synchronization), as are ``__init__``
+    accesses (construction happens-before ``start()``) and attributes
+    never written after construction."""
+    tm = thread_model(ctx)
+    out: List[Finding] = []
+    for cls in tm.spawning_classes():
+        if not tm.thread_fns:
+            continue
+        accesses = [a for a in tm.attr_accesses(cls)
+                    if tm.attr_types.get((cls, a.attr))
+                    not in _SAFE_ATTR_KINDS
+                    and a.attr not in tm.methods.get(cls, {})
+                    and getattr(a.fn, "name", "") not in _PRE_START_METHODS]
+        by_attr: Dict[str, List] = {}
+        for a in accesses:
+            by_attr.setdefault(a.attr, []).append(a)
+        for attr, accs in sorted(by_attr.items()):
+            if not any(a.is_write for a in accs):
+                continue  # read-only after construction
+            thread_side = [a for a in accs if a.fn in tm.thread_fns]
+            main_side = [a for a in accs if a.fn not in tm.thread_fns]
+            if not thread_side or not main_side:
+                continue
+            bad = None
+            for t in thread_side:
+                for m in main_side:
+                    if not (t.is_write or m.is_write):
+                        continue
+                    if not (t.locks_held & m.locks_held):
+                        bad = t if t.is_write else m
+                        break
+                if bad:
+                    break
+            if bad:
+                out.append(_finding(
+                    ctx, "unguarded-shared-state", bad.node,
+                    f"`self.{attr}` is shared between the thread "
+                    f"entrypoint path and other methods of "
+                    f"`{cls.name}` with no common lock held",
+                    "guard every access with one lock (`with self._lock:`)"
+                    " or hand the value over via a queue/Event; if the "
+                    "access is provably pre-start or GIL-atomic, add an "
+                    "inline allow with the why"))
+    return out
+
+
+# ---------------------------------------------------------------- lock-order ----
+
+def _acquires_transitive(tm) -> Dict[ast.AST, Set[str]]:
+    """fn -> every lock it (or an in-file callee) may acquire lexically."""
+    direct: Dict[ast.AST, Set[str]] = {}
+    for fn in tm.ctx.functions:
+        acq: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.With) and \
+                    tm.ctx.enclosing_function(node) is fn:
+                for item in node.items:
+                    lk = tm.canonical_lock(item.context_expr, node)
+                    if lk is not None:
+                        acq.add(lk)
+        direct[fn] = acq
+    callees: Dict[ast.AST, Set[ast.AST]] = {}
+    for fn in tm.ctx.functions:
+        cs: Set[ast.AST] = set()
+        for call in ast.walk(fn):
+            if isinstance(call, ast.Call) and \
+                    tm.ctx.enclosing_function(call) is fn:
+                cs.update(tm._resolve_callable(call.func, fn))
+        callees[fn] = cs
+    trans = {fn: set(acq) for fn, acq in direct.items()}
+    for _ in range(10):
+        changed = False
+        for fn in tm.ctx.functions:
+            before = len(trans[fn])
+            for c in callees.get(fn, ()):
+                trans[fn] |= trans.get(c, set())
+            if len(trans[fn]) != before:
+                changed = True
+        if not changed:
+            break
+    return trans
+
+
+@register("lock-order")
+def lock_order(ctx: ModuleContext) -> Iterable[Finding]:
+    """Static lock-acquisition graph: an edge A→B when B is acquired (in
+    this function or an in-file callee) while A is held. A cycle means two
+    threads taking the locks in opposite orders can deadlock. The runtime
+    lockwatch watchdog covers the cross-module orders this in-file pass
+    cannot see."""
+    tm = thread_model(ctx)
+    if not (tm.locks or tm.conditions):
+        return []
+    trans = _acquires_transitive(tm)
+    edges: Dict[Tuple[str, str], ast.AST] = {}
+    for fn in ctx.functions:
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.With)
+                    and ctx.enclosing_function(node) is fn):
+                continue
+            inner: Set[str] = set()
+            for stmt in node.body:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.With):
+                        for item in sub.items:
+                            lk = tm.canonical_lock(item.context_expr, sub)
+                            if lk is not None:
+                                inner.add(lk)
+                    if isinstance(sub, ast.Call):
+                        for callee in tm._resolve_callable(sub.func, fn):
+                            inner |= trans.get(callee, set())
+            for item in node.items:
+                outer = tm.canonical_lock(item.context_expr, node)
+                if outer is None:
+                    continue
+                for b in inner - {outer}:
+                    edges.setdefault((outer, b), node)
+    # cycle detection over the edge set
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+
+    def reaches(src: str, dst: str) -> bool:
+        seen, stack = set(), [src]
+        while stack:
+            cur = stack.pop()
+            if cur == dst:
+                return True
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.extend(graph.get(cur, ()))
+        return False
+
+    out: List[Finding] = []
+    for (a, b), node in sorted(edges.items(),
+                               key=lambda kv: kv[1].lineno):
+        if reaches(b, a):  # the reverse order is also taken somewhere
+            out.append(_finding(
+                ctx, "lock-order", node,
+                f"lock-order cycle: `{a}` is held while acquiring `{b}`, "
+                f"but elsewhere `{b}` is held while acquiring `{a}` — two "
+                "threads in opposite orders deadlock",
+                "pick one global order (document it) and release the "
+                "outer lock before taking the inner one on the reversed "
+                "path"))
+    return out
+
+
+# --------------------------------------------------------- blocking-under-lock ----
+
+_SOCKET_BLOCKING = {"recv", "recv_into", "accept", "connect",
+                    "create_connection"}
+_SUBPROCESS_BLOCKING = {"run", "call", "check_call", "check_output",
+                        "communicate", "wait"}
+_DEVICE_SYNC = {"block_until_ready", "device_get"}
+_NP_PREFIXES = ("np.", "numpy.", "onp.")
+
+
+def _is_blocking_call(call: ast.Call) -> str:
+    lp = last_part(call.func)
+    d = dotted(call.func)
+    if lp in _SOCKET_BLOCKING:
+        return f"socket {lp}()"
+    if lp in _DEVICE_SYNC:
+        return f"device sync {lp}()"
+    if d.startswith("subprocess.") and lp in _SUBPROCESS_BLOCKING:
+        return f"{d}()"
+    if d == "time.sleep":
+        return "time.sleep()"
+    if lp == "join" and not call.args:
+        return ".join()"  # thread/queue join (str.join has an argument)
+    if lp == "open" and isinstance(call.func, ast.Name):
+        return "file open()"
+    if d.startswith(_NP_PREFIXES) and lp in ("asarray", "array") \
+            and call.args and not isinstance(call.args[0], ast.Constant):
+        return f"{d}() device fetch"
+    return ""
+
+
+@register("blocking-under-lock")
+def blocking_under_lock(ctx: ModuleContext) -> Iterable[Finding]:
+    """A blocking operation — socket recv/accept/connect, file open,
+    thread/queue ``join()``, ``block_until_ready``/``device_get`` (and
+    ``np.asarray`` of a device value), ``subprocess``, ``time.sleep`` —
+    executed while holding a lock stalls every thread contending for that
+    lock for the full duration (and a join on a thread that needs the
+    lock deadlocks outright). ``Condition.wait`` on the held lock is the
+    sanctioned exception: it releases while waiting."""
+    tm = thread_model(ctx)
+    if not (tm.locks or tm.conditions):
+        return []
+    out: List[Finding] = []
+    for fn in ctx.functions:
+        for call in ast.walk(fn):
+            if not (isinstance(call, ast.Call)
+                    and ctx.enclosing_function(call) is fn):
+                continue
+            held = tm.locks_held(call)
+            if not held:
+                continue
+            what = _is_blocking_call(call)
+            if not what:
+                continue
+            # cond.wait()/ev.wait() released the held lock by design
+            if (isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "wait"):
+                continue
+            out.append(_finding(
+                ctx, "blocking-under-lock", call,
+                f"{what} while holding {', '.join(sorted(held))} — every "
+                "thread contending for the lock stalls for the full "
+                "duration",
+                "move the blocking work outside the critical section "
+                "(snapshot under the lock, block after release); if the "
+                "lock deliberately serializes this operation, add an "
+                "inline allow with the why"))
+    return out
+
+
+# ------------------------------------------------------------- unjoined-thread ----
+
+@register("unjoined-thread")
+def unjoined_thread(ctx: ModuleContext) -> Iterable[Finding]:
+    """A ``threading.Thread`` that is started but never joined anywhere in
+    the module has no deterministic shutdown: interpreter teardown races
+    the thread's last writes — the exact shape of the PR 10
+    tracker-shutdown flake. Daemon threads are NOT exempt; daemonhood
+    suppresses the hang, not the race."""
+    tm = thread_model(ctx)
+    if not tm.started_threads:
+        return []
+
+    # names/attrs something calls .join() on (zero positional args — a
+    # str.join always passes the iterable)
+    joined_locals: Set[str] = set()
+    joined_attrs: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join" and not node.args):
+            base = node.func.value
+            if isinstance(base, ast.Name):
+                joined_locals.add(base.id)
+            elif (isinstance(base, ast.Attribute)
+                  and isinstance(base.value, ast.Name)
+                  and base.value.id == "self"):
+                joined_attrs.add(base.attr)
+    # propagate: `for t in threads: t.join()` joins `threads`;
+    # `t, self._thread = self._thread, None` + `t.join()` joins `_thread`
+    for _ in range(3):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.For,)) and isinstance(
+                    node.target, ast.Name) and \
+                    node.target.id in joined_locals and isinstance(
+                        node.iter, ast.Name):
+                joined_locals.add(node.iter.id)
+            if isinstance(node, ast.Assign):
+                tgt_names = {el.id for t in node.targets
+                             for el in ast.walk(t)
+                             if isinstance(el, ast.Name)}
+                if tgt_names & joined_locals:
+                    for el in ast.walk(node.value):
+                        if (isinstance(el, ast.Attribute)
+                                and isinstance(el.value, ast.Name)
+                                and el.value.id == "self"):
+                            joined_attrs.add(el.attr)
+                        elif isinstance(el, ast.Name):
+                            joined_locals.add(el.id)
+
+    out: List[Finding] = []
+    for call in tm.started_threads:
+        par = ctx.parents.get(call)
+        bound_locals: Set[str] = set()
+        bound_attrs: Set[str] = set()
+        returned = False
+        node = call
+        while node in ctx.parents and not isinstance(node, ast.stmt):
+            node = ctx.parents[node]
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    bound_locals.add(t.id)
+                elif (isinstance(t, ast.Attribute)
+                      and isinstance(t.value, ast.Name)
+                      and t.value.id == "self"):
+                    bound_attrs.add(t.attr)
+        elif isinstance(node, ast.Return):
+            returned = True
+        # list-comp / append-into-list bindings: the list's name
+        comp = ctx.parents.get(call)
+        while comp is not None and not isinstance(
+                comp, (ast.stmt, ast.ListComp)):
+            comp = ctx.parents.get(comp)
+        if isinstance(comp, ast.ListComp):
+            stmt = comp
+            while stmt in ctx.parents and not isinstance(stmt, ast.stmt):
+                stmt = ctx.parents[stmt]
+            if isinstance(stmt, ast.Assign):
+                bound_locals.update(t.id for t in stmt.targets
+                                    if isinstance(t, ast.Name))
+        if returned:
+            continue  # the caller owns the handle
+        if bound_locals & joined_locals or bound_attrs & joined_attrs:
+            continue
+        out.append(_finding(
+            ctx, "unjoined-thread", call,
+            "thread is started but never joined in this module — shutdown "
+            "is nondeterministic (interpreter teardown races the thread)",
+            "keep the handle and join it (with a timeout) from the "
+            "owner's stop()/close()/finally path; signal the loop to "
+            "exit first (Event/sentinel)"))
+    return out
+
+
+# -------------------------------------------------- condition-wait-no-predicate ----
+
+@register("condition-wait-no-predicate")
+def condition_wait_no_predicate(ctx: ModuleContext) -> Iterable[Finding]:
+    """``Condition.wait()`` can wake spuriously and can lose a race to
+    another consumer — the predicate MUST be re-checked in a ``while``
+    loop around the wait. ``Event.wait(timeout)`` whose boolean result is
+    discarded outside a loop has the same bug: the caller proceeds
+    whether or not the event fired."""
+    tm = thread_model(ctx)
+    if not (tm.conditions or tm.events):
+        return []
+    out: List[Finding] = []
+    for call in ast.walk(ctx.tree):
+        if not (isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr in ("wait", "wait_for")):
+            continue
+        name = tm._lock_name_of(call.func.value, call)
+        if name in tm.conditions:
+            kind = "condition"
+        elif name in tm.events:
+            kind = "event"
+        else:
+            continue
+        if call.func.attr == "wait_for":
+            continue  # wait_for loops on the predicate internally
+        in_while = False
+        cur = call
+        fn = ctx.enclosing_function(call)
+        while cur in ctx.parents and cur is not fn:
+            cur = ctx.parents[cur]
+            if isinstance(cur, ast.While):
+                in_while = True
+                break
+        if in_while:
+            continue
+        if kind == "event":
+            par = ctx.parents.get(call)
+            if not isinstance(par, ast.Expr):
+                continue  # result is checked — a timed one-shot wait
+        out.append(_finding(
+            ctx, "condition-wait-no-predicate", call,
+            f"{kind} `{name}`.wait() outside a while loop — spurious "
+            "wakeups and lost races make a single un-re-checked wait "
+            "incorrect",
+            "wrap it: `while not <predicate>: cond.wait(timeout)` (or "
+            "use `wait_for(predicate, timeout)`); for Events, check the "
+            "returned bool"))
+    return out

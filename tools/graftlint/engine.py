@@ -259,15 +259,18 @@ def lint_source(src: str, path: str,
     return deduped
 
 
-def lint_file(path: str, rel_path: Optional[str] = None) -> List[Finding]:
+def lint_file(path: str, rel_path: Optional[str] = None,
+              rule_ids: Optional[Iterable[str]] = None) -> List[Finding]:
     with open(path, "r", encoding="utf-8") as f:
         src = f.read()
-    return lint_source(src, rel_path or path)
+    return lint_source(src, rel_path or path, rule_ids=rule_ids)
 
 
-def lint_paths(paths: Iterable[str], root: str) -> List[Finding]:
+def lint_paths(paths: Iterable[str], root: str,
+               rule_ids: Optional[Iterable[str]] = None) -> List[Finding]:
     """Lint every ``.py`` under each path (file or directory), reporting
-    repo-relative posix paths."""
+    repo-relative posix paths. ``rule_ids`` restricts to those rules
+    (the lint_gate ``--rule`` triage filter)."""
     import os
 
     files: List[str] = []
@@ -285,6 +288,6 @@ def lint_paths(paths: Iterable[str], root: str) -> List[Finding]:
     out: List[Finding] = []
     for fp in files:
         rel = os.path.relpath(fp, root).replace(os.sep, "/")
-        out.extend(lint_file(fp, rel))
+        out.extend(lint_file(fp, rel, rule_ids=rule_ids))
     out.sort(key=lambda f: (f.path, f.line, f.rule))
     return out

@@ -1,0 +1,356 @@
+"""Thread-model analysis for the concurrency rules (ISSUE 11).
+
+Mirrors the traced-context analysis in ``engine.ModuleContext``: a
+per-module, import-free AST pass answering the questions every
+host-concurrency rule needs —
+
+- which functions run on **spawned threads**: ``threading.Thread(
+  target=...)`` targets, everything transitively reachable from them
+  through in-file calls, and the methods of server **handler classes**
+  (``BaseRequestHandler`` / ``BaseHTTPRequestHandler`` subclasses ride
+  ``ThreadingTCPServer`` / ``ThreadingHTTPServer`` worker threads);
+- which **locks** exist (``threading.Lock/RLock/Condition`` and the
+  ``utils.lockwatch`` seam's ``make_lock/make_rlock/make_condition``),
+  with ``Condition(self._lock)`` aliased to the lock it wraps — holding
+  the condition IS holding that lock;
+- which locks are **held** at any given node: the lexical ``with lock:``
+  nesting, plus a call-graph fixpoint so a helper only ever invoked from
+  inside lock regions (``DecodeEngine._accept_token``) counts as
+  guarded;
+- which ``self.*`` attributes each class's methods read/write (subscript
+  stores and mutating method calls like ``.append``/``.pop`` count as
+  writes).
+
+Like the traced analysis, this is deliberately in-file: the idioms it
+polices — a class that owns both its threads and its locks — are local
+by construction in this tree, and the runtime half
+(``utils/lockwatch.py``) covers the cross-module lock orders statics
+cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graftlint.engine import ModuleContext, dotted, last_part
+
+# constructors whose result is itself thread-safe (or a lock): sharing the
+# OBJECT across threads is the point, so accesses to these attrs are not
+# "unguarded shared state"
+_LOCK_CTORS = {"Lock", "RLock", "make_lock", "make_rlock"}
+_CONDITION_CTORS = {"Condition", "make_condition"}
+_THREADSAFE_CTORS = _LOCK_CTORS | _CONDITION_CTORS | {
+    "Event", "Semaphore", "BoundedSemaphore", "Barrier", "Queue",
+    "LifoQueue", "PriorityQueue", "SimpleQueue", "Thread", "Timer",
+    "local", "ThreadPoolExecutor", "count",  # itertools.count: GIL-atomic
+}
+_HANDLER_BASES = {"BaseRequestHandler", "StreamRequestHandler",
+                  "DatagramRequestHandler", "BaseHTTPRequestHandler",
+                  "SimpleHTTPRequestHandler"}
+_MUTATING_METHODS = {"append", "appendleft", "extend", "insert", "remove",
+                     "pop", "popleft", "clear", "add", "discard", "update",
+                     "setdefault", "sort", "reverse", "__setitem__"}
+
+_FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+@dataclasses.dataclass
+class AttrAccess:
+    """One ``self.X`` touch inside a method of a thread-owning class."""
+
+    cls: ast.ClassDef
+    fn: ast.AST
+    attr: str
+    is_write: bool
+    locks_held: frozenset  # canonical lock names held at the access
+    node: ast.AST
+
+    @property
+    def lineno(self) -> int:
+        return self.node.lineno
+
+
+class ThreadModel:
+    """The shared concurrency analyses, built once per module and cached
+    on the ``ModuleContext`` (rules call :func:`thread_model`)."""
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        self.classes: List[ast.ClassDef] = [
+            n for n in ast.walk(ctx.tree) if isinstance(n, ast.ClassDef)]
+        self.class_of: Dict[ast.AST, ast.ClassDef] = {}
+        self.methods: Dict[ast.ClassDef, Dict[str, ast.AST]] = {}
+        for cls in self.classes:
+            meths: Dict[str, ast.AST] = {}
+            for node in cls.body:  # direct methods only — nested defs run
+                if isinstance(node, _FuncDef):  # in their method's scope
+                    meths[node.name] = node
+                    self.class_of[node] = cls
+            self.methods[cls] = meths
+        self.handler_classes = [
+            cls for cls in self.classes
+            if any(last_part(b) in _HANDLER_BASES for b in cls.bases)]
+        # lock discovery + Condition-wraps-lock aliasing
+        self.locks: Set[str] = set()
+        self.conditions: Set[str] = set()
+        self.events: Set[str] = set()
+        self.alias: Dict[str, str] = {}
+        self.attr_types: Dict[Tuple[Optional[ast.ClassDef], str], str] = {}
+        self._find_locks()
+        # thread entrypoints and the reachable-from-thread closure
+        self.thread_targets: Set[ast.AST] = set()
+        self.started_threads: List[ast.Call] = []
+        self._find_threads()
+        self.thread_fns: Set[ast.AST] = self._reachable(self.thread_targets)
+        # call-graph lock propagation: fn -> locks guaranteed held at entry
+        self.guaranteed: Dict[ast.AST, frozenset] = self._propagate_locks()
+
+    # ------------------------------------------------------------ naming ----
+    def canonical_lock(self, node: ast.AST,
+                       scope: Optional[ast.AST] = None) -> Optional[str]:
+        """Canonical name for a lock-valued expression at ``node``:
+        ``ClassName.attr`` for ``self.attr``, the bare name for locals and
+        module globals — ``None`` when the expression is not a known lock.
+        Condition aliases resolve to the lock they wrap."""
+        name = self._lock_name_of(node, scope)
+        if name is None:
+            return None
+        seen = set()
+        while name in self.alias and name not in seen:
+            seen.add(name)
+            name = self.alias[name]
+        return name if name in self.locks or name in self.conditions else None
+
+    def _lock_name_of(self, node: ast.AST,
+                      scope: Optional[ast.AST]) -> Optional[str]:
+        if isinstance(node, ast.Attribute) and isinstance(node.value,
+                                                          ast.Name) \
+                and node.value.id == "self":
+            cls = self._scope_class(scope or node)
+            if cls is not None:
+                return f"{cls.name}.{node.attr}"
+            return f"?.{node.attr}"
+        if isinstance(node, ast.Name):
+            return node.id
+        return None
+
+    def _scope_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        cur = node
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur
+            cur = self.ctx.parents.get(cur)
+        return None
+
+    # ------------------------------------------------------------- locks ----
+    def _find_locks(self) -> None:
+        for node in ast.walk(self.ctx.tree):
+            if not (isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call)):
+                continue
+            ctor = last_part(node.value.func)
+            for tgt in node.targets:
+                name = self._lock_name_of(tgt, tgt)
+                if name is None:
+                    continue
+                if ctor in _LOCK_CTORS:
+                    self.locks.add(name)
+                    self._note_attr_type(tgt, "lock")
+                elif ctor in _CONDITION_CTORS:
+                    self.conditions.add(name)
+                    self._note_attr_type(tgt, "condition")
+                    # Condition(self._lock): holding the condition holds
+                    # the wrapped lock — alias them to one node
+                    wrapped = (self._lock_name_of(node.value.args[0],
+                                                  node.value.args[0])
+                               if node.value.args else None)
+                    if wrapped is not None:
+                        self.alias[name] = wrapped
+                    else:
+                        # a bare Condition() owns a private lock: treat the
+                        # condition name itself as the lock node
+                        self.locks.add(name)
+                elif ctor == "Event":
+                    self.events.add(name)
+                    self._note_attr_type(tgt, "threadsafe")
+                elif ctor in _THREADSAFE_CTORS:
+                    self._note_attr_type(tgt, "threadsafe")
+
+    def _note_attr_type(self, tgt: ast.AST, kind: str) -> None:
+        if isinstance(tgt, ast.Attribute) and isinstance(tgt.value,
+                                                         ast.Name) \
+                and tgt.value.id == "self":
+            cls = self._scope_class(tgt)
+            self.attr_types[(cls, tgt.attr)] = kind
+
+    # ----------------------------------------------------------- threads ----
+    def _resolve_callable(self, node: ast.AST,
+                          scope: ast.AST) -> List[ast.AST]:
+        """Function defs a callable expression may refer to: ``self.m`` →
+        the method, a bare name → same-name defs in the module."""
+        if isinstance(node, ast.Attribute) and isinstance(node.value,
+                                                          ast.Name) \
+                and node.value.id == "self":
+            cls = self._scope_class(scope)
+            if cls is not None and node.attr in self.methods.get(cls, {}):
+                return [self.methods[cls][node.attr]]
+            return []
+        if isinstance(node, ast.Name):
+            return list(self.ctx.defs_by_name.get(node.id, []))
+        if isinstance(node, ast.Lambda):
+            return [node]
+        return []
+
+    def _find_threads(self) -> None:
+        for node in ast.walk(self.ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and last_part(node.func) in ("Thread", "Timer")):
+                continue
+            self.started_threads.append(node)
+            target = next((kw.value for kw in node.keywords
+                           if kw.arg == "target"), None)
+            if target is None and last_part(node.func) == "Timer" \
+                    and len(node.args) >= 2:
+                target = node.args[1]
+            if target is not None:
+                for fn in self._resolve_callable(target, node):
+                    self.thread_targets.add(fn)
+        for cls in self.handler_classes:
+            for fn in self.methods.get(cls, {}).values():
+                self.thread_targets.add(fn)
+        # executor.submit(fn, ...) / executor.map(fn, ...): fn runs on a
+        # pool thread
+        for node in ast.walk(self.ctx.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("submit",)
+                    and node.args):
+                for fn in self._resolve_callable(node.args[0], node):
+                    self.thread_targets.add(fn)
+
+    def _reachable(self, seeds: Set[ast.AST]) -> Set[ast.AST]:
+        out = set(seeds)
+        for _ in range(10):
+            before = len(out)
+            for fn in list(out):
+                for node in ast.walk(fn):
+                    if isinstance(node, _FuncDef) and node is not fn:
+                        out.add(node)  # nested defs run on the same thread
+                    if isinstance(node, ast.Call):
+                        for d in self._resolve_callable(node.func, fn):
+                            if isinstance(d, _FuncDef + (ast.Lambda,)):
+                                out.add(d)
+            if len(out) == before:
+                break
+        return out
+
+    # ------------------------------------------------------- locks held ----
+    def lexical_locks(self, node: ast.AST) -> frozenset:
+        """Canonical locks held at ``node`` by enclosing ``with`` blocks
+        within the same function."""
+        held = set()
+        cur = node
+        fn = self.ctx.enclosing_function(node)
+        while cur is not None and cur is not fn:
+            par = self.ctx.parents.get(cur)
+            if isinstance(par, ast.With) and cur in par.body:
+                for item in par.items:
+                    lk = self.canonical_lock(item.context_expr, par)
+                    if lk is not None:
+                        held.add(lk)
+            cur = par
+        return frozenset(held)
+
+    def _propagate_locks(self) -> Dict[ast.AST, frozenset]:
+        """fn → locks held at EVERY in-file call site (intersection);
+        thread targets and never-called functions start at the empty set.
+        One fixpoint pass over the in-file call graph."""
+        callsites: Dict[ast.AST, List[Tuple[ast.AST, ast.Call]]] = {}
+        for fn in self.ctx.functions:
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call) or \
+                        self.ctx.enclosing_function(call) is not fn:
+                    continue
+                for callee in self._resolve_callable(call.func, fn):
+                    callsites.setdefault(callee, []).append((fn, call))
+        guaranteed: Dict[ast.AST, frozenset] = {
+            fn: frozenset() for fn in self.ctx.functions}
+        for _ in range(10):
+            changed = False
+            for fn in self.ctx.functions:
+                sites = callsites.get(fn)
+                if not sites or fn in self.thread_targets:
+                    new = frozenset()
+                else:
+                    sets = [guaranteed.get(caller, frozenset())
+                            | self.lexical_locks(call)
+                            for caller, call in sites]
+                    new = frozenset.intersection(*sets) if sets \
+                        else frozenset()
+                if new != guaranteed.get(fn):
+                    guaranteed[fn] = new
+                    changed = True
+            if not changed:
+                break
+        return guaranteed
+
+    def locks_held(self, node: ast.AST) -> frozenset:
+        fn = self.ctx.enclosing_function(node)
+        base = self.guaranteed.get(fn, frozenset()) if fn is not None \
+            else frozenset()
+        return base | self.lexical_locks(node)
+
+    # ----------------------------------------------------- attr accesses ----
+    def spawning_classes(self) -> List[ast.ClassDef]:
+        """Classes that start threads (a ``Thread(...)`` call inside one of
+        their methods) — the scope the shared-state rule polices."""
+        out = []
+        for cls in self.classes:
+            for call in self.started_threads:
+                fn = self.ctx.enclosing_function(call)
+                if fn is not None and self.class_of.get(fn) is cls:
+                    out.append(cls)
+                    break
+        return out
+
+    def attr_accesses(self, cls: ast.ClassDef) -> List[AttrAccess]:
+        out: List[AttrAccess] = []
+        for fn in self.methods.get(cls, {}).values():
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Attribute)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == "self"):
+                    continue
+                out.append(AttrAccess(cls, fn, node.attr,
+                                      self._is_write(node),
+                                      self.locks_held(node), node))
+        return out
+
+    def _is_write(self, attr_node: ast.Attribute) -> bool:
+        if isinstance(attr_node.ctx, (ast.Store, ast.Del)):
+            return True
+        par = self.ctx.parents.get(attr_node)
+        # self.x[...] = v  /  self.x[...] += v
+        if isinstance(par, ast.Subscript) and isinstance(
+                par.ctx, (ast.Store, ast.Del)):
+            return True
+        if isinstance(par, ast.AugAssign) and par.target is attr_node:
+            return True
+        # self.x.append(...) and friends mutate in place
+        if isinstance(par, ast.Attribute) and par.attr in _MUTATING_METHODS:
+            grand = self.ctx.parents.get(par)
+            if isinstance(grand, ast.Call) and grand.func is par:
+                return True
+        return False
+
+
+def thread_model(ctx: ModuleContext) -> ThreadModel:
+    """Get-or-build the module's ThreadModel (cached on the context so the
+    five concurrency rules share one analysis pass)."""
+    tm = getattr(ctx, "_thread_model", None)
+    if tm is None:
+        tm = ThreadModel(ctx)
+        ctx._thread_model = tm
+    return tm

@@ -53,12 +53,17 @@ DEFAULT_TARGETS = (
 
 
 def run_gate(paths=None, baseline_path: str = BASELINE_PATH,
-             use_baseline: bool = True):
-    """(non-baselined findings, stale baseline entries, all findings)."""
-    findings = lint_paths(paths or DEFAULT_TARGETS, REPO_ROOT)
+             use_baseline: bool = True, rule_ids=None):
+    """(non-baselined findings, stale baseline entries, all findings).
+    ``rule_ids`` restricts the run to those rules (triage mode: stale
+    entries for the non-run rules are not reported)."""
+    findings = lint_paths(paths or DEFAULT_TARGETS, REPO_ROOT,
+                          rule_ids=rule_ids)
     if not use_baseline:
         return findings, [], findings
     entries = load_baseline(baseline_path)
+    if rule_ids is not None:
+        entries = [e for e in entries if e["rule"] in set(rule_ids)]
     fresh, used, stale = apply_baseline(findings, entries)
     fixme = [e for e in used if e["why"].startswith("FIXME")]
     if fixme:  # an unjustified allowlist entry is itself a finding
@@ -85,6 +90,10 @@ def main(argv=None) -> int:
                     help="baseline/allowlist path")
     ap.add_argument("--no-baseline", action="store_true",
                     help="report raw findings, ignoring the baseline")
+    ap.add_argument("--rule", action="append", dest="rules", metavar="ID",
+                    help="run only this rule (repeatable) — the triage "
+                         "filter for working one rule's findings; stale "
+                         "entries for other rules are not reported")
     ap.add_argument("--update-baseline", action="store_true",
                     help="regenerate the baseline from current findings "
                          "(carries forward existing whys; new entries get "
@@ -92,7 +101,17 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     paths = args.paths or None
+    if args.rules:
+        from tools.graftlint import RULES
+
+        unknown = [r for r in args.rules if r not in RULES]
+        if unknown:
+            ap.error(f"unknown rule(s): {', '.join(unknown)} "
+                     f"(known: {', '.join(sorted(RULES))})")
     if args.update_baseline:
+        if args.rules:
+            ap.error("--update-baseline regenerates the FULL baseline; "
+                     "it cannot be combined with --rule")
         findings = lint_paths(paths or DEFAULT_TARGETS, REPO_ROOT)
         old = load_baseline(args.baseline)
         entries = write_baseline(args.baseline, findings, old)
@@ -103,7 +122,8 @@ def main(argv=None) -> int:
         return 0
 
     fresh, stale, all_findings = run_gate(
-        paths, args.baseline, use_baseline=not args.no_baseline)
+        paths, args.baseline, use_baseline=not args.no_baseline,
+        rule_ids=args.rules)
     if args.as_json:
         print(json.dumps({
             "findings": [f.to_dict() for f in fresh],

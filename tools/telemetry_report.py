@@ -59,6 +59,24 @@ def format_report(summary: dict, path: str) -> str:
     width = max(len(r[0]) for r in rows)
     lines = [f"telemetry report — {path}", "-" * (width + 24)]
     lines += [f"{name:<{width}}  {value}" for name, value in rows]
+    # lock telemetry (ISSUE 11): one row per watched lock when the run
+    # carried utils.lockwatch metrics; silent otherwise
+    watch = summary.get("lockwatch")
+    if watch:
+        lines += ["", "lockwatch (per watched lock)",
+                  f"{'lock':<24} {'acquires':>9} {'contended':>9} "
+                  f"{'hold p.max ms':>13} {'wait max ms':>11}"]
+        names = sorted({k[len("lockwatch_"):-len("_acquires")]
+                        for k in watch if k.endswith("_acquires")})
+        for name in names:
+            get = lambda stat: watch.get(f"lockwatch_{name}_{stat}", 0)  # noqa: E731
+            lines.append(
+                f"{name:<24} {get('acquires'):>9.0f} "
+                f"{get('contended'):>9.0f} {get('hold_ms_max'):>13.3f} "
+                f"{get('wait_ms_max'):>11.3f}")
+        for flag in ("lockwatch_cycles", "lockwatch_watchdog_dumps"):
+            if watch.get(flag):
+                lines.append(f"!! {flag}: {watch[flag]:.0f}")
     if bad:
         lines.append(
             f"WARNING: {sum(bad.values())} non-finite metric value(s) in "
