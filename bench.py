@@ -1549,6 +1549,41 @@ def measure_serve() -> float:
     lockwatch_overhead_pct = round(
         (1.0 - report_w.tokens_per_sec / report.tokens_per_sec) * 100.0, 2)
 
+    # ---- tracing overhead twin (ISSUE 12): the SAME bf16 open-loop run
+    # with a process tracer configured — every request becomes a
+    # serve.request span tree (queue_wait/prefill/decode/retire children,
+    # per-token accept events) and every scheduler iteration an
+    # engine.step span, all written as eager begin/end JSONL records.
+    # Budget: <5% tokens/s cost (asserted in test_bench_smoke with the
+    # shared noise retry, mirroring the lockwatch twin); the detail also
+    # proves the span→attribution chain through the REAL report code.
+    import tempfile
+
+    from deeplearning4j_tpu.telemetry import trace as trace_mod
+
+    trace_dir = tempfile.mkdtemp(prefix="bench_serve_trace_")
+    tracer = trace_mod.Tracer("serve-bench", trace_dir=trace_dir)
+    prev_tracer = trace_mod.set_tracer(tracer)
+    try:
+        engine_t = DecodeEngine(params, heads, n_slots=slots,
+                                max_len=max_len, serve_dtype="bf16")
+        warm(engine_t)
+        report_t = run_open_loop(engine_t, prompts, rate_rps=rate,
+                                 max_new_tokens=max_new)
+    finally:
+        trace_mod.set_tracer(prev_tracer)
+        tracer.close()
+    trace_overhead_pct = round(
+        (1.0 - report_t.tokens_per_sec / report.tokens_per_sec) * 100.0, 2)
+    from tools.trace_report import load_trace_dir, serve_attribution
+
+    attribution = serve_attribution(load_trace_dir(trace_dir))
+    # the acceptance sum: queue+prefill+decode+gap within 1ms of latency
+    attribution_max_err_ms = max(
+        (abs(r["total_ms"] - r["queue_wait_ms"] - r["prefill_ms"]
+             - r["decode_ms"] - r["gap_ms"])
+         for r in attribution if r["status"] != "open"), default=None)
+
     detail = {
         "slots": slots, "max_len": max_len, "n_requests": n_req,
         "max_new_tokens": max_new, "offered_rps": rate,
@@ -1557,10 +1592,14 @@ def measure_serve() -> float:
         "latency": {
             "p50_ms": round(report.latency_p50_ms, 2),
             "p95_ms": round(report.latency_p95_ms, 2),
+            "p99_ms": round(report.latency_p99_ms, 2),
             "mean_ms": round(report.latency_mean_ms, 2),
             "first_token_p50_ms": (
                 round(report.first_token_p50_ms, 2)
                 if report.first_token_p50_ms is not None else None),
+            "first_token_p99_ms": (
+                round(report.first_token_p99_ms, 2)
+                if report.first_token_p99_ms is not None else None),
         },
         "completed": report.completed,
         "naive_tokens_per_sec": round(naive_rate, 1),
@@ -1585,6 +1624,16 @@ def measure_serve() -> float:
             "graph": watch["graph"],
             "engine_lock": watch["locks"].get("serve.engine", {}),
             "metrics": watch_rec,
+        },
+        "tracing": {
+            "overhead_pct": trace_overhead_pct,
+            "tokens_per_sec_traced": round(report_t.tokens_per_sec, 1),
+            "requests_traced": len(attribution),
+            "open_requests": sum(1 for r in attribution
+                                 if r["status"] == "open"),
+            "attribution_max_err_ms": attribution_max_err_ms,
+            "latency_p99_ms_traced": round(report_t.latency_p99_ms, 2),
+            "sample_attribution": attribution[-1] if attribution else None,
         },
     }
     print("STAGE_DETAIL " + json.dumps(detail), flush=True)
@@ -1943,8 +1992,12 @@ def main() -> None:
         "bench_report), the naive recompute-per-token baseline at the SAME "
         "bf16 weights (one full forward over the padded window per token, "
         "sequential — what cli predict used to do), the serve_vs_naive "
-        "ratio, mean slot occupancy, and the int8 weight-only A/B twin "
-        "(serve_dtype seam, serve/quant.py)."
+        "ratio, mean slot occupancy, the int8 weight-only A/B twin "
+        "(serve_dtype seam, serve/quant.py), and the ISSUE 12 tracing "
+        "twin: the same open-loop run with request-scoped spans armed "
+        "(trace_overhead_pct <5% budget) plus the per-request latency "
+        "attribution reconstructed through tools/trace_report.py. "
+        "Latency rows carry p50/p95/p99 (ISSUE 12: the SLO tail)."
     )
     detail["word2vec_sharded_note"] = (
         "word2vec_sharded = the toy word2vec stage driven through "

@@ -92,6 +92,7 @@ _IDEMPOTENT = frozenset({
     "add_job", "job_for", "clear_job", "has_pending_jobs",
     "add_update", "updates_versioned", "clear_updates_versioned",
     "set_current", "get_current",
+    "put_kv", "get_kv", "kv_snapshot",
     "add_replicate", "needs_replicate", "done_replicating",
     "count", "counters_snapshot", "finish", "is_done",
     "set_best_loss", "best_loss", "early_stop", "is_early_stop",
@@ -387,6 +388,17 @@ class StateTrackerClient(StateTracker):
 
     def get_current(self):
         return self._call("get_current")
+
+    # ---- generic KV blobs (ISSUE 12; last-write-wins per key, so the
+    # writes are retry-safe idempotent like set_current) ----
+    def put_kv(self, key, value):
+        return self._call("put_kv", key, value)
+
+    def get_kv(self, key, default=None):
+        return self._call("get_kv", key, default)
+
+    def kv_snapshot(self, prefix: str = ""):
+        return self._call("kv_snapshot", prefix)
 
     # ---- replication ----
     def add_replicate(self, worker_id):

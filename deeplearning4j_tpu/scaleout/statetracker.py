@@ -47,6 +47,12 @@ class StateTracker:
     def add_replicate(self, worker_id: str) -> None: raise NotImplementedError
     def needs_replicate(self, worker_id: str) -> bool: raise NotImplementedError
     def done_replicating(self, worker_id: str) -> None: raise NotImplementedError
+    # generic KV blobs (ISSUE 12: the Hazelcast-map shape — last-write-wins
+    # per key; telemetry federation pushes per-process registry snapshots
+    # through these, exactly how the elastic membership rides the counters)
+    def put_kv(self, key: str, value: Any) -> None: raise NotImplementedError
+    def get_kv(self, key: str, default: Any = None) -> Any: raise NotImplementedError
+    def kv_snapshot(self, prefix: str = "") -> Dict[str, Any]: raise NotImplementedError
     # counters / lifecycle
     def increment(self, key: str, by: float = 1.0) -> None: raise NotImplementedError
     def count(self, key: str) -> float: raise NotImplementedError
@@ -77,6 +83,7 @@ class InMemoryStateTracker(StateTracker):
         self._jobs: Dict[str, Job] = {}
         self._updates: Dict[str, Job] = {}
         self._current: Any = None
+        self._kv: Dict[str, Any] = {}
         self._replicate: set = set()
         self._counters: Dict[str, float] = defaultdict(float)
         self._done = False
@@ -154,6 +161,22 @@ class InMemoryStateTracker(StateTracker):
     def done_replicating(self, worker_id: str) -> None:
         with self._lock:
             self._replicate.discard(worker_id)
+
+    # ---- generic KV blobs (ISSUE 12) ----
+    def put_kv(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._kv[str(key)] = value
+
+    def get_kv(self, key: str, default: Any = None) -> Any:
+        with self._lock:
+            return self._kv.get(str(key), default)
+
+    def kv_snapshot(self, prefix: str = "") -> Dict[str, Any]:
+        """All KV entries under ``prefix`` in one read — the federation
+        aggregator pays one RPC per collect, not one per process."""
+        with self._lock:
+            return {k: v for k, v in self._kv.items()
+                    if k.startswith(prefix)}
 
     # ---- counters / lifecycle ----
     def increment(self, key: str, by: float = 1.0) -> None:

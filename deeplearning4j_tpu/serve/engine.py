@@ -34,6 +34,21 @@ precision.
 Telemetry flows through the PR 2 registry under ``serve_*`` (queue depth,
 slot occupancy, token/request counters, prefill/decode/request latency
 histograms) and is served by ``UiServer`` at ``/api/serve``.
+
+Request-scoped tracing (ISSUE 12): when a process tracer is configured
+(telemetry/trace.py), every request becomes a ``serve.request`` span with
+``serve.queue_wait`` / ``serve.prefill`` / ``serve.decode`` /
+``serve.retire`` children — per-token ``accept`` events on the decode
+span, retire reason + weight version as attributes — and every scheduler
+iteration an ``engine.step`` span recording admissions / occupancy /
+retirements. Spans parent under the submitting thread's current span
+(the UiServer handler's ``http.request`` span, itself parented under an
+inbound W3C ``traceparent``), so one trace tree spans loadgen → HTTP →
+engine scheduler thread. The begin records are written eagerly, so a
+``kill -9`` mid-request leaves open ``serve.request`` spans that
+``tools/trace_report.py`` reconstructs, exactly like the elastic rounds.
+Unconfigured, all of it is a None-check per call site — zero cost, and
+the greedy-parity + 0-compile pins run tracer-armed in test_serve.py.
 """
 
 from __future__ import annotations
@@ -59,6 +74,7 @@ from deeplearning4j_tpu.serve.quant import (
     params_nbytes,
     prepare_serve_params,
 )
+from deeplearning4j_tpu.telemetry import trace as _trace
 from deeplearning4j_tpu.utils.lockwatch import make_condition, make_rlock
 
 _UNSET = object()
@@ -84,8 +100,16 @@ class ServeRequest:
         self.done = threading.Event()
         self.slot: Optional[int] = None
         self.t_submit: float = 0.0
+        self.t_admit: Optional[float] = None
         self.t_first: Optional[float] = None
         self.t_done: Optional[float] = None
+        # tracing (ISSUE 12): None unless a process tracer is configured
+        # at submit time — every touch below is a None-check when off
+        self.span = None          # serve.request (submit → retire)
+        self.queue_span = None    # serve.queue_wait (submit → admission)
+        self.decode_span = None   # serve.decode (admission → retire)
+        self.prefill_ms: float = 0.0
+        self.decode_ms: float = 0.0  # sum of decode dispatches it rode
 
     @property
     def latency_s(self) -> Optional[float]:
@@ -106,7 +130,8 @@ class DecodeEngine:
                  attn_impl: Optional[str] = None,
                  serve_dtype: Optional[str] = "bf16",
                  eos_id: Optional[int] = None, seed: int = 0,
-                 registry=None, min_bucket: int = 8):
+                 registry=None, min_bucket: int = 8,
+                 weight_version: Optional[str] = None):
         from deeplearning4j_tpu.telemetry.registry import default_registry
 
         if n_slots < 1:
@@ -123,6 +148,10 @@ class DecodeEngine:
         self.top_k = int(top_k)
         self.serve_dtype = serve_dtype
         self.eos_id = eos_id
+        # per-request weight/checkpoint forensics (ISSUE 12; ROADMAP 4's
+        # hot-swap will bump this between decode steps): recorded on every
+        # serve.retire span and in stats()
+        self.weight_version = weight_version
         self.registry = registry if registry is not None else \
             default_registry()
         self.params = prepare_serve_params(params, serve_dtype)
@@ -203,6 +232,7 @@ class DecodeEngine:
                 "n_heads is not recoverable from param shapes — save with "
                 "meta=lm_checkpoint_meta(params, n_heads) or pass n_heads=")
         kwargs.setdefault("top_k", int(lm_meta.get("top_k", 2)))
+        kwargs.setdefault("weight_version", f"ckpt-step-{manifest.step}")
         return cls(params, int(n_heads), **kwargs)
 
     # ---------------------------------------------------------- admission ----
@@ -243,6 +273,20 @@ class DecodeEngine:
                            temperature,
                            self.eos_id if eos_id is _UNSET else eos_id)
         req.t_submit = time.perf_counter()
+        tracer = _trace.get_tracer()
+        if tracer is not None:
+            # parents under the submitting thread's current span (the
+            # UiServer http.request span / a loadgen span), or roots a
+            # fresh trace; children below parent under it EXPLICITLY
+            # because they run on the scheduler thread
+            req.span = tracer.start_span(
+                "serve.request",
+                attrs={"rid": req.rid, "prompt_len": len(prompt),
+                       "max_new_tokens": req.max_new_tokens,
+                       "temperature": req.temperature,
+                       "weight_version": self.weight_version})
+            req.queue_span = tracer.start_span("serve.queue_wait",
+                                               parent=req.span)
         with self._work:
             self._queue.append(req)
             self.requests_total += 1
@@ -260,6 +304,14 @@ class DecodeEngine:
     def _admit(self, req: ServeRequest, slot: int) -> None:
         n = len(req.prompt)
         bucket = self.bucket_for(n)
+        if req.queue_span is not None:
+            req.queue_span.end()
+            req.queue_span = None
+        req.t_admit = time.perf_counter()
+        prefill_span = (req.span.tracer.start_span(
+            "serve.prefill", parent=req.span,
+            attrs={"slot": slot, "bucket": bucket, "prompt_len": n})
+            if req.span is not None else None)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :n] = req.prompt
         t0 = time.perf_counter()
@@ -269,6 +321,9 @@ class DecodeEngine:
         self._step_idx += 1
         tok = int(np.asarray(tok))  # graftlint: allow[blocking-under-lock] deliberate: the scheduler lock IS the serialization — slot state may only change together with the fenced prefill result
         now = time.perf_counter()
+        req.prefill_ms = (now - t0) * 1000.0
+        if prefill_span is not None:
+            prefill_span.end()
         self.registry.histogram("serve_prefill_ms").observe(
             (now - t0) * 1000.0)
         req.slot = slot
@@ -276,6 +331,11 @@ class DecodeEngine:
         self._slots[slot] = req
         self._positions[slot] = n
         self._temps[slot] = req.temperature
+        if req.span is not None:
+            # started BEFORE the first accept: max_new_tokens=1 / instant
+            # EOS retire the request inside this very call
+            req.decode_span = req.span.tracer.start_span(
+                "serve.decode", parent=req.span, attrs={"slot": slot})
         self._accept_token(req, tok, now)
 
     def _accept_token(self, req: ServeRequest, tok: int, now: float) -> None:
@@ -285,6 +345,9 @@ class DecodeEngine:
             self._finish(req, "eos", now)
             return
         req.generated.append(tok)
+        if req.decode_span is not None:
+            req.decode_span.add_event("accept", token=tok,
+                                      n=len(req.generated))
         self.tokens_total += 1
         self.registry.counter("serve_tokens_total").inc()
         if len(req.generated) >= req.max_new_tokens:
@@ -298,6 +361,34 @@ class DecodeEngine:
     def _finish(self, req: ServeRequest, reason: str, now: float) -> None:
         req.finish_reason = reason
         req.t_done = now
+        if req.span is not None:
+            if req.decode_span is not None:
+                req.decode_span.set_attr("decode_ms",
+                                         round(req.decode_ms, 3))
+                req.decode_span.set_attr("tokens", len(req.generated))
+                req.decode_span.end()
+                req.decode_span = None
+            retire = req.span.tracer.start_span(
+                "serve.retire", parent=req.span,
+                attrs={"reason": reason, "tokens": len(req.generated),
+                       "weight_version": self.weight_version})
+            retire.end()
+            # the latency-attribution attrs tools/trace_report.py tables:
+            # queue_wait + prefill + decode + gap ≡ latency by construction
+            # (gap = scheduler time the request sat admitted but outside
+            # its own prefill/decode dispatches)
+            queue_ms = ((req.t_admit or now) - req.t_submit) * 1000.0
+            latency_ms = (now - req.t_submit) * 1000.0
+            req.span.set_attr("queue_wait_ms", round(queue_ms, 3))
+            req.span.set_attr("prefill_ms", round(req.prefill_ms, 3))
+            req.span.set_attr("decode_ms", round(req.decode_ms, 3))
+            req.span.set_attr("gap_ms", round(
+                latency_ms - queue_ms - req.prefill_ms - req.decode_ms, 3))
+            req.span.set_attr("latency_ms", round(latency_ms, 3))
+            req.span.set_attr("tokens", len(req.generated))
+            req.span.set_attr("finish_reason", reason)
+            req.span.end()
+            req.span = None
         if req.slot is not None:
             self._slots[req.slot] = None
             self._tokens[req.slot] = 0
@@ -322,18 +413,28 @@ class DecodeEngine:
     def step(self) -> int:
         """One scheduler iteration: admit into free slots, then one fused
         decode step over every slot. Returns tokens emitted (0 = idle)."""
+        tracer = _trace.get_tracer()
+        step_span = (tracer.start_span("engine.step", parent=False)
+                     if tracer is not None else None)
         with self._lock:
             tokens_before = self.tokens_total
             free = self._free_slots()
+            admitted = 0
             while self._queue and free:
                 req = self._queue.pop(0)
                 self._admit(req, free.pop(0))
+                admitted += 1
             self.registry.gauge("serve_queue_depth").set(
                 float(len(self._queue)))
             active = [r for r in self._slots if r is not None]
             self.registry.gauge("serve_active_slots").set(
                 float(len(active)))
             if not active:
+                if step_span is not None:
+                    step_span.set_attr("admissions", admitted)
+                    step_span.set_attr("occupancy", 0)
+                    step_span.set_attr("idle", True)
+                    step_span.end()
                 return self.tokens_total - tokens_before
             t0 = time.perf_counter()
             self._cache, toks = self._decode(
@@ -342,16 +443,28 @@ class DecodeEngine:
             self._step_idx += 1
             toks = np.asarray(toks)  # graftlint: allow[blocking-under-lock] deliberate: retirement must see the fenced decode tokens; submit() blocks here only between decode steps
             now = time.perf_counter()
+            decode_ms = (now - t0) * 1000.0
             self.registry.histogram("serve_decode_step_ms").observe(
-                (now - t0) * 1000.0)
+                decode_ms)
             self.decode_steps += 1
             self._occupancy_sum += len(active)
             for req in active:
                 slot = req.slot
+                if req.decode_span is not None:
+                    req.decode_ms += decode_ms
                 self._positions[slot] += 1
                 self._accept_token(req, int(toks[slot]), now)
+            occupancy_after = sum(r is not None for r in self._slots)
             self.registry.gauge("serve_active_slots").set(
-                float(sum(r is not None for r in self._slots)))
+                float(occupancy_after))
+            if step_span is not None:
+                step_span.set_attr("admissions", admitted)
+                step_span.set_attr("occupancy", len(active))
+                step_span.set_attr("retired",
+                                   len(active) - occupancy_after)
+                step_span.set_attr("queue_depth", len(self._queue))
+                step_span.set_attr("decode_ms", round(decode_ms, 3))
+                step_span.end()
             return self.tokens_total - tokens_before
 
     def run_until_idle(self, max_steps: int = 100_000) -> int:
@@ -417,10 +530,30 @@ class DecodeEngine:
 
     # -------------------------------------------------------------- stats ----
     def stats(self) -> dict:
-        """The ``/api/serve`` snapshot: scheduler state + throughput."""
+        """The ``/api/serve`` snapshot: scheduler state + throughput +
+        per-in-flight-request ages (ISSUE 12 satellite — a stuck request
+        is visible from the UI as a growing ``queued_s``/``running_s``,
+        not only as a hung client)."""
         with self._lock:
+            now = time.perf_counter()
+            in_flight = []
+            for r in self._queue:
+                in_flight.append({
+                    "rid": r.rid, "state": "queued",
+                    "queued_s": round(now - r.t_submit, 3),
+                    "tokens": 0, "prompt_len": len(r.prompt)})
+            for r in self._slots:
+                if r is None:
+                    continue
+                in_flight.append({
+                    "rid": r.rid, "state": "running", "slot": r.slot,
+                    "queued_s": round(
+                        ((r.t_admit or now) - r.t_submit), 3),
+                    "running_s": round(now - (r.t_admit or now), 3),
+                    "tokens": len(r.generated),
+                    "prompt_len": len(r.prompt)})
             active = sum(r is not None for r in self._slots)
-            elapsed = (time.perf_counter() - self._t_first_activity
+            elapsed = (now - self._t_first_activity
                        if self._t_first_activity is not None else 0.0)
             return {
                 "slots": self.n_slots,
@@ -429,6 +562,7 @@ class DecodeEngine:
                 "max_len": self.max_len,
                 "serve_dtype": self.serve_dtype or "f32",
                 "weight_bytes": self.weight_bytes,
+                "weight_version": self.weight_version,
                 "prefill_buckets": list(self._buckets),
                 "requests_total": self.requests_total,
                 "tokens_total": self.tokens_total,
@@ -437,6 +571,19 @@ class DecodeEngine:
                                    if self.decode_steps else 0.0),
                 "tokens_per_sec": (self.tokens_total / elapsed
                                    if elapsed > 0 else 0.0),
+                "in_flight": in_flight,
                 "model": dict(self.dims, n_heads=self.n_heads,
                               top_k=self.top_k),
             }
+
+    def metrics_record(self) -> dict:
+        """Every ``serve_*`` instrument in this engine's registry as a
+        flat step-log-ready dict (labeled counters summed, histograms as
+        ``_count``/``_sum``) — the block ``summarize_step_log`` and
+        ``tools/telemetry_report.py`` render, mirroring
+        ``lockwatch.metrics_record()`` (pinned by the ISSUE 12 meta-test:
+        a serve metric that exists in the registry cannot ship
+        unrendered)."""
+        from deeplearning4j_tpu.telemetry.registry import flat_record
+
+        return flat_record(self.registry, prefixes=("serve_",))

@@ -18,9 +18,17 @@ Two drivers:
   front-end smoke).
 
 Both return a :class:`LoadReport` with tokens/s and exact (not
-bucket-approximated) p50/p95 latency over the recorded per-request
-latencies — the numbers ``bench.py serve`` publishes and
-``tools/bench_report.py`` tracks as LOWER-IS-BETTER rows.
+bucket-approximated) p50/p95/p99 latency over the recorded per-request
+latencies (full-request AND first-token) — the numbers ``bench.py serve``
+publishes and ``tools/bench_report.py`` tracks as LOWER-IS-BETTER rows.
+
+Tracing (ISSUE 12): when a process tracer is configured, the HTTP driver
+opens one ``loadgen.request`` span per request and sends its context as
+a W3C ``traceparent`` header, so the server's ``http.request`` span and
+the engine's ``serve.request`` subtree parent under it — one trace tree
+from the traffic generator through the HTTP server into the scheduler
+thread, renderable by ``tools/trace_report.py``. The in-process driver
+needs no header: ``engine.submit`` roots the tree directly.
 """
 
 from __future__ import annotations
@@ -33,6 +41,8 @@ import urllib.request
 from typing import List, Optional, Sequence
 
 import numpy as np
+
+from deeplearning4j_tpu.telemetry import trace as _trace
 
 
 @dataclasses.dataclass
@@ -49,7 +59,9 @@ class LoadReport:
     latency_p50_ms: float
     latency_p95_ms: float
     latency_mean_ms: float
+    latency_p99_ms: float = 0.0
     first_token_p50_ms: Optional[float] = None
+    first_token_p99_ms: Optional[float] = None
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -66,11 +78,14 @@ def arrival_schedule(n: int, rate_rps: float, seed: int = 0) -> List[float]:
 
 
 def _percentiles(values_ms: List[float]) -> tuple:
+    """(p50, p95, p99, mean) over exact recorded latencies — the p99 tail
+    is the number a fleet SLO is written against (ISSUE 12 satellite:
+    reported everywhere latency is)."""
     if not values_ms:
-        return 0.0, 0.0, 0.0
+        return 0.0, 0.0, 0.0, 0.0
     arr = np.asarray(values_ms)
     return (float(np.percentile(arr, 50)), float(np.percentile(arr, 95)),
-            float(arr.mean()))
+            float(np.percentile(arr, 99)), float(arr.mean()))
 
 
 def run_open_loop(engine, prompts: Sequence[Sequence[int]],
@@ -113,15 +128,17 @@ def run_open_loop(engine, prompts: Sequence[Sequence[int]],
         lat.append((req.t_done - arrival) * 1000.0)
         if req.t_first is not None:
             first.append((req.t_first - arrival) * 1000.0)
-    p50, p95, mean = _percentiles(lat)
+    p50, p95, p99, mean = _percentiles(lat)
+    ft = _percentiles(first) if first else None
     duration = t_end - t0
     return LoadReport(
         n_requests=len(prompts), completed=done, duration_s=duration,
         tokens_out=tokens,
         tokens_per_sec=tokens / duration if duration > 0 else 0.0,
         offered_rps=rate_rps, latency_p50_ms=p50, latency_p95_ms=p95,
-        latency_mean_ms=mean,
-        first_token_p50_ms=_percentiles(first)[0] if first else None)
+        latency_p99_ms=p99, latency_mean_ms=mean,
+        first_token_p50_ms=ft[0] if ft else None,
+        first_token_p99_ms=ft[2] if ft else None)
 
 
 def run_open_loop_http(base_url: str, prompts: Sequence[Sequence[int]],
@@ -143,13 +160,25 @@ def run_open_loop_http(base_url: str, prompts: Sequence[Sequence[int]],
         body = json.dumps({"prompt": list(map(int, prompt)),
                            "max_new_tokens": max_new_tokens,
                            "temperature": temperature}).encode()
+        headers = {"Content-Type": "application/json"}
+        tracer = _trace.get_tracer()
+        span = (tracer.start_span("loadgen.request", parent=False,
+                                  attrs={"i": i, "offset_s": round(offset, 4),
+                                         "prompt_len": len(prompt)})
+                if tracer is not None else None)
+        if span is not None:
+            headers["traceparent"] = _trace.format_traceparent(
+                span.context())
         req = urllib.request.Request(
             base_url.rstrip("/") + "/api/generate", data=body,
-            headers={"Content-Type": "application/json"})
-        start = time.perf_counter()
-        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
-            results[i] = json.loads(resp.read())
-        lat_ms[i] = (time.perf_counter() - (t0 + offset)) * 1000.0
+            headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                results[i] = json.loads(resp.read())
+            lat_ms[i] = (time.perf_counter() - (t0 + offset)) * 1000.0
+        finally:
+            if span is not None:
+                span.end()
 
     threads = [threading.Thread(target=fire, args=(i, off, p), daemon=True)
                for i, (off, p) in enumerate(zip(offsets, prompts))]
@@ -160,12 +189,12 @@ def run_open_loop_http(base_url: str, prompts: Sequence[Sequence[int]],
     t_end = time.perf_counter()
     done = [i for i, r in enumerate(results) if r is not None]
     tokens = sum(len(results[i].get("tokens", [])) for i in done)
-    p50, p95, mean = _percentiles([lat_ms[i] for i in done
-                                   if lat_ms[i] is not None])
+    p50, p95, p99, mean = _percentiles([lat_ms[i] for i in done
+                                        if lat_ms[i] is not None])
     duration = t_end - t0
     return LoadReport(
         n_requests=len(prompts), completed=len(done), duration_s=duration,
         tokens_out=tokens,
         tokens_per_sec=tokens / duration if duration > 0 else 0.0,
         offered_rps=rate_rps, latency_p50_ms=p50, latency_p95_ms=p95,
-        latency_mean_ms=mean)
+        latency_p99_ms=p99, latency_mean_ms=mean)

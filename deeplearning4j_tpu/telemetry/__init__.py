@@ -16,6 +16,11 @@ Three layers, one subsystem:
   protocol and blob metas) + a per-process crash flight recorder dumped
   on error/SIGTERM and checkpointed write-ahead at round boundaries —
   merged into round timelines by tools/trace_report.py;
+- **federation** (federation.py, ISSUE 12): per-process registries
+  pushed as versioned JSON snapshots through the StateTracker KV map and
+  merged into one cluster view (counters sum, gauges per-process,
+  histograms bucket-merge, lapsed pushers marked stale) served at
+  ``/api/cluster`` and ``/metrics?scope=cluster``;
 - **performance attribution** (xprofile.py, ISSUE 9): compile-time
   introspection of every jitted step behind the ``profile=`` seam —
   XLA cost/memory analysis, HLO collective inventory, measured-MFU /
@@ -31,9 +36,15 @@ from deeplearning4j_tpu.telemetry.metrics import (
     train_step_metrics,
     update_metrics,
 )
+from deeplearning4j_tpu.telemetry.federation import (
+    ClusterAggregator,
+    MetricsPusher,
+    merge_snapshots,
+)
 from deeplearning4j_tpu.telemetry.prometheus import (
     CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE,
     render_prometheus,
+    render_snapshot,
     sanitize_name,
 )
 from deeplearning4j_tpu.telemetry.registry import (
@@ -43,6 +54,7 @@ from deeplearning4j_tpu.telemetry.registry import (
     Histogram,
     MetricsRegistry,
     default_registry,
+    flat_record,
 )
 from deeplearning4j_tpu.telemetry.session import (
     DEFAULT_INTERVAL,
@@ -52,8 +64,10 @@ from deeplearning4j_tpu.telemetry.trace import (
     Span,
     Tracer,
     current_trace_context,
+    format_traceparent,
     get_tracer,
     maybe_span,
+    parse_traceparent,
     set_tracer,
 )
 from deeplearning4j_tpu.telemetry.step_log import (
@@ -73,12 +87,14 @@ from deeplearning4j_tpu.telemetry.xprofile import (
 )
 
 __all__ = [
+    "ClusterAggregator",
     "Counter",
     "DEFAULT_BUCKETS",
     "DEFAULT_INTERVAL",
     "Gauge",
     "Histogram",
     "MemoryWatermarkSampler",
+    "MetricsPusher",
     "MetricsRegistry",
     "PROMETHEUS_CONTENT_TYPE",
     "ProfileStore",
@@ -94,8 +110,13 @@ __all__ = [
     "profile_lowered",
     "current_trace_context",
     "default_registry",
+    "flat_record",
+    "format_traceparent",
     "get_tracer",
     "maybe_span",
+    "merge_snapshots",
+    "parse_traceparent",
+    "render_snapshot",
     "set_tracer",
     "global_norm",
     "read_step_log",

@@ -54,7 +54,15 @@ def _fmt(value: float) -> str:
 
 def render_prometheus(registry: MetricsRegistry) -> str:
     """The full exposition page for one registry."""
-    snap = registry.snapshot()
+    return render_snapshot(registry.snapshot())
+
+
+def render_snapshot(snap: Dict) -> str:
+    """Render any registry-snapshot-shaped dict (``{"counters": [...],
+    "gauges": [...], "histograms": [...]}``) — the seam that lets the
+    federation aggregator's MERGED cluster view (telemetry/federation.py)
+    ship over ``/metrics?scope=cluster`` through the exact renderer the
+    per-process page uses."""
     lines: List[str] = []
     seen_type: set = set()
 

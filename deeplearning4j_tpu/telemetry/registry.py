@@ -207,6 +207,36 @@ class MetricsRegistry:
             }
 
 
+def flat_record(registry: "MetricsRegistry",
+                prefixes: Sequence[str] = ()) -> Dict[str, float]:
+    """Flatten a registry snapshot into a step-log-ready ``{name: value}``
+    dict (ISSUE 12): counters sum across label sets, gauges last-write
+    (unlabeled name wins last), histograms contribute ``<name>_count`` /
+    ``<name>_sum``. ``prefixes`` restricts to names starting with any of
+    them (empty = everything). This is the one flattening every
+    ``metrics_record()`` emitter uses, so a NEW instrument under a
+    rendered prefix automatically reaches tools/telemetry_report.py."""
+    snap = registry.snapshot()
+
+    def keep(name: str) -> bool:
+        return not prefixes or any(name.startswith(p) for p in prefixes)
+
+    out: Dict[str, float] = {}
+    for row in snap["counters"]:
+        if keep(row["name"]):
+            out[row["name"]] = out.get(row["name"], 0.0) + row["value"]
+    for row in snap["gauges"]:
+        if keep(row["name"]):
+            out[row["name"]] = row["value"]
+    for row in snap["histograms"]:
+        if keep(row["name"]):
+            out[f"{row['name']}_count"] = (
+                out.get(f"{row['name']}_count", 0.0) + row["count"])
+            out[f"{row['name']}_sum"] = (
+                out.get(f"{row['name']}_sum", 0.0) + row["sum"])
+    return out
+
+
 # process-wide default registry: the zero-ceremony path for listeners, the
 # statetracker mirror, and the UI server (explicit registries compose fine)
 _default: Optional[MetricsRegistry] = None

@@ -124,7 +124,7 @@ def summarize_step_log(records: List[Dict]) -> Dict:
     """Aggregate a step log into the throughput/grad-norm summary the
     bench detail and tools/telemetry_report.py print.
 
-    Returns {steps, wall_ms: {mean, p50, p95}, tokens_per_sec_mean,
+    Returns {steps, wall_ms: {mean, p50, p95, p99}, tokens_per_sec_mean,
     loss: {first, last}, grad_norm: {first, last}, router_load_mean}.
     Absent fields are simply omitted. When any metric carried NaN/Inf
     values a ``nonfinite`` {metric: count} map is included (plus
@@ -157,7 +157,8 @@ def summarize_step_log(records: List[Dict]) -> Dict:
 
         out["wall_ms"] = {"mean": round(statistics.fmean(walls), 3),
                           "p50": round(pct(50), 3),
-                          "p95": round(pct(95), 3)}
+                          "p95": round(pct(95), 3),
+                          "p99": round(pct(99), 3)}
     tps = series("tokens_per_sec")
     if tps:
         out["tokens_per_sec_mean"] = round(statistics.fmean(tps), 1)
@@ -185,4 +186,18 @@ def summarize_step_log(records: List[Dict]) -> Dict:
                 lockwatch[k] = v
     if lockwatch:
         out["lockwatch"] = lockwatch
+    # serve + federation registry metrics (ISSUE 12): records carrying
+    # ``serve_*`` / ``federation_*`` keys (DecodeEngine.metrics_record /
+    # federation metrics_record via registry.flat_record) surface as one
+    # block each — cumulative registry values, so the latest record wins;
+    # absent keys mean the subsystem never ran and the block is omitted
+    for prefix, block_key in (("serve_", "serve"),
+                              ("federation_", "federation")):
+        block: Dict = {}
+        for r in records:
+            for k, v in r.items():
+                if k.startswith(prefix) and isinstance(v, (int, float)):
+                    block[k] = v
+        if block:
+            out[block_key] = block
     return out

@@ -65,6 +65,13 @@ def _new_id() -> str:
     return uuid.uuid4().hex[:16]
 
 
+def _new_trace_id() -> str:
+    # W3C-native width (32 hex): a locally-rooted trace propagates over
+    # HTTP traceparent without padding, so the SAME id string appears in
+    # every process's span file and the report merges them as one tree
+    return uuid.uuid4().hex
+
+
 def _jsonable(v):
     if hasattr(v, "tolist"):
         v = v.tolist()
@@ -238,7 +245,7 @@ class Tracer:
             trace_id = str(parent["trace_id"])
             parent_id = parent.get("span_id")
         else:
-            trace_id, parent_id = _new_id(), None
+            trace_id, parent_id = _new_trace_id(), None
         span = Span(self, name, trace_id, parent_id, attrs)
         rec = span.begin_record()
         with self._lock:
@@ -449,3 +456,46 @@ def current_trace_context() -> Optional[Dict[str, str]]:
     None when tracing is off / no span is open."""
     tracer = _tracer
     return tracer.current_context() if tracer is not None else None
+
+
+# ------------------------------------------------ W3C traceparent (ISSUE 12) ----
+# The HTTP serving path propagates context as a ``traceparent`` header
+# (https://www.w3.org/TR/trace-context/): ``00-<32 hex trace>-<16 hex
+# span>-<2 hex flags>``. Internal ids are 16 hex chars (``_new_id``), so
+# formatting left-pads to the W3C width and parsing keeps the full 32-char
+# id as-is — trace ids are opaque strings everywhere in this tracer, so a
+# caller-minted 32-char id flows through spans, sinks, and reports
+# unchanged, and the one trace tree spans loadgen → HTTP → engine.
+
+def format_traceparent(ctx: Dict[str, str]) -> str:
+    """A ``traceparent`` header value for a span context dict. Ids shorter
+    than the W3C widths are left-padded with zeros (parse→format is
+    identity for ids already at full width)."""
+    trace_id = str(ctx["trace_id"]).lower().rjust(32, "0")
+    span_id = str(ctx["span_id"]).lower().rjust(16, "0")
+    return f"00-{trace_id}-{span_id}-01"
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[Dict[str, str]]:
+    """Parse a ``traceparent`` header into a span-context dict, or None
+    when the header is absent or malformed. Per the W3C spec a bad header
+    is IGNORED (the request proceeds as a fresh root trace), never an
+    error — tests/test_ui.py pins that a garbage header cannot 400 a
+    generation request."""
+    if not header or not isinstance(header, str):
+        return None
+    parts = header.strip().split("-")
+    if len(parts) < 4:
+        return None
+    version, trace_id, span_id = parts[0], parts[1], parts[2]
+    if version == "ff" or len(version) != 2:
+        return None
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(version, 16), int(trace_id, 16), int(span_id, 16)
+    except ValueError:
+        return None
+    if set(trace_id) == {"0"} or set(span_id) == {"0"}:
+        return None  # all-zero ids are explicitly invalid in the spec
+    return {"trace_id": trace_id.lower(), "span_id": span_id.lower()}

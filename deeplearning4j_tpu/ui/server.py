@@ -34,8 +34,13 @@ INDEX_HTML = """<!doctype html>
 </ul>
 <h2>serving</h2>
 <ul>
-<li><a href="/api/serve">decode-engine stats (queue, slots, throughput)</a></li>
-<li>POST /api/generate {"prompt": [ids], "max_new_tokens": N, "temperature": T}</li>
+<li><a href="/api/serve">decode-engine stats (queue, slots, in-flight request ages)</a></li>
+<li>POST /api/generate {"prompt": [ids], "max_new_tokens": N, "temperature": T} (traceparent honoured)</li>
+</ul>
+<h2>cluster</h2>
+<ul>
+<li><a href="/api/cluster">federated cluster metrics (merged registries + staleness)</a></li>
+<li><a href="/metrics?scope=cluster">cluster-scope Prometheus metrics</a></li>
 </ul>
 <h2>api</h2>
 <ul>
@@ -74,6 +79,7 @@ class UiServer:
         self._tracer = None
         self._profile_store = None
         self._engine = None
+        self._federation = None
         self._generate_timeout_s = 120.0
 
     # ---- telemetry (ISSUE 2: Prometheus + JSON export on the UI port) ----
@@ -115,6 +121,17 @@ class UiServer:
         handler drives the scheduler inline."""
         self._engine = engine
         self._generate_timeout_s = float(generate_timeout_s)
+
+    # ---- federation (ISSUE 12: the cluster view on the UI port) ----
+    def attach_federation(self, aggregator) -> None:
+        """Serve a telemetry.federation.ClusterAggregator: GET
+        ``/api/cluster`` returns the merged cluster view (per-process
+        push ages + staleness flags, counters summed, gauges
+        per-process-labeled, histograms bucket-merged) and ``GET
+        /metrics?scope=cluster`` the same view as Prometheus text with
+        ``federation_process_up`` marking lapsed pushers. Collected at
+        request time — one tracker read per scrape."""
+        self._federation = aggregator
 
     # ---- uploads (ref ApiResource: the reference POSTs these; in-process
     # registration serves the same purpose without copying through HTTP) ----
@@ -165,15 +182,20 @@ class UiServer:
                 pass
 
             def _send(self, code: int, body: bytes,
-                      ctype: str = "application/json") -> None:
+                      ctype: str = "application/json",
+                      extra_headers=None) -> None:
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _json(self, obj, code: int = 200) -> None:
-                self._send(code, json.dumps(obj).encode("utf-8"))
+            def _json(self, obj, code: int = 200,
+                      extra_headers=None) -> None:
+                self._send(code, json.dumps(obj).encode("utf-8"),
+                           extra_headers=extra_headers)
 
             def do_GET(self):
                 url = urlparse(self.path)
@@ -186,8 +208,26 @@ class UiServer:
                     from deeplearning4j_tpu.telemetry.prometheus import (
                         CONTENT_TYPE,
                         render_prometheus,
+                        render_snapshot,
                     )
 
+                    scope = q.get("scope", ["process"])[0]
+                    if scope == "cluster":
+                        # ISSUE 12: the federated cluster view — merged
+                        # per-process registries, stale pushers marked
+                        # via federation_process_up
+                        if ui._federation is None:
+                            self._json({"error": "no federation "
+                                        "aggregator attached"}, 404)
+                            return
+                        self._send(200, render_snapshot(
+                            ui._federation.prometheus_snapshot()
+                        ).encode("utf-8"), CONTENT_TYPE)
+                        return
+                    if scope != "process":
+                        self._json({"error": "scope must be 'process' or "
+                                    "'cluster'"}, 400)
+                        return
                     if ui._metrics_registry is None:
                         self._json({"error": "no metrics registry attached"},
                                    404)
@@ -196,6 +236,12 @@ class UiServer:
                                render_prometheus(
                                    ui._metrics_registry).encode("utf-8"),
                                CONTENT_TYPE)
+                elif url.path == "/api/cluster":
+                    if ui._federation is None:
+                        self._json({"error": "no federation aggregator "
+                                    "attached"}, 404)
+                        return
+                    self._json(ui._federation.collect())
                 elif url.path == "/api/telemetry":
                     snap = (ui._metrics_registry.snapshot()
                             if ui._metrics_registry is not None else {})
@@ -352,19 +398,44 @@ class UiServer:
                     self._json({"error": "max_new_tokens/temperature must "
                                 "be numbers"}, 400)
                     return
+                # ISSUE 12: W3C trace-context propagation — an inbound
+                # ``traceparent`` parents this handler's span (and the
+                # engine's serve.request tree under it) beneath the
+                # CALLER's trace; a malformed header is IGNORED per the
+                # spec (fresh root trace, never a 400). With no process
+                # tracer this is one None-check.
+                from deeplearning4j_tpu.telemetry import trace as _trace
+
+                ctx = _trace.parse_traceparent(
+                    self.headers.get("traceparent"))
+                sp = None
                 try:
-                    tokens = ui._engine.generate(
-                        prompt, max_new_tokens=max_new,
-                        temperature=temperature,
-                        timeout=ui._generate_timeout_s)
+                    with _trace.maybe_span(
+                            "http.request",
+                            parent=ctx,
+                            attrs={"path": url.path,
+                                   "prompt_len": len(prompt),
+                                   "remote_trace": ctx is not None}) as sp:
+                        tokens = ui._engine.generate(
+                            prompt, max_new_tokens=max_new,
+                            temperature=temperature,
+                            timeout=ui._generate_timeout_s)
                 except ValueError as exc:  # engine-side validation
                     self._json({"error": str(exc)}, 400)
                     return
                 except TimeoutError:
                     self._json({"error": "generation timed out"}, 503)
                     return
-                self._json({"tokens": tokens, "n": len(tokens),
-                            "prompt_len": len(prompt)})
+                resp = {"tokens": tokens, "n": len(tokens),
+                        "prompt_len": len(prompt)}
+                headers = None
+                if sp is not None:
+                    # the response carries the trace id both ways: JSON
+                    # for API clients, traceparent for W3C middleboxes
+                    resp["trace_id"] = sp.trace_id
+                    headers = {"traceparent":
+                               _trace.format_traceparent(sp.context())}
+                self._json(resp, extra_headers=headers)
 
         return Handler
 

@@ -1,0 +1,55 @@
+"""Child process for the serve-tracing kill -9 test (ISSUE 12).
+
+Configures a process tracer, builds a tiny decode engine, submits a
+request with a large token budget onto the background scheduler, prints
+``READY`` once the request is mid-decode, then idles until the parent
+SIGKILLs it. The tracer writes span begin records eagerly, so the death
+leaves an open ``serve.request`` (plus its ``serve.decode`` child and
+open ``engine.step`` spans) that tools/trace_report.py must reconstruct
+— the same write-ahead forensic posture the elastic rounds pinned in
+PR 7.
+
+Run: ``python tests/_serve_trace_child.py TRACE_DIR`` (CPU platform is
+forced here, mirroring tests/conftest.py, since this child has no
+conftest).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    trace_dir = sys.argv[1]
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from deeplearning4j_tpu.models.transformer_lm import init_lm_params
+    from deeplearning4j_tpu.serve import DecodeEngine
+    from deeplearning4j_tpu.telemetry import trace as tr
+
+    tr.configure("serve-victim", trace_dir, crash_hooks=False)
+    params = init_lm_params(jax.random.PRNGKey(0), 31, 8, 2, 2, 16,
+                            n_layers=1)
+    # max_len 2048 → thousands of decode steps: the request is still
+    # mid-stream whenever the parent's SIGKILL lands after READY
+    engine = DecodeEngine(params, 2, n_slots=1, max_len=2048,
+                          serve_dtype=None)
+    engine.start()
+    req = engine.submit([1, 2, 3], max_new_tokens=1_000_000)
+    # wait until the request is genuinely mid-decode before signalling
+    while not req.generated:
+        time.sleep(0.01)
+    print("READY", flush=True)
+    # idle; the parent kill -9s us mid-request (no hook will run — only
+    # the eagerly-written begin records survive)
+    time.sleep(120)
+    engine.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

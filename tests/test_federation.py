@@ -1,0 +1,298 @@
+"""ISSUE 12: tracker-federated cluster metrics (telemetry/federation.py).
+
+Merge semantics pinned both as pure functions (counter sum, gauge
+per-process labeling, histogram bucket-merge incl. the union-of-bounds
+fallback) AND against two live registries pushed through the real TCP
+tracker (StateTrackerServer + two StateTrackerClients), with staleness
+marking for a pusher whose heartbeat lapsed. The UI surface
+(``/api/cluster``, ``/metrics?scope=cluster``) rides the same live pair.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from deeplearning4j_tpu.scaleout.remote_tracker import (
+    StateTrackerClient,
+    StateTrackerServer,
+)
+from deeplearning4j_tpu.scaleout.statetracker import InMemoryStateTracker
+from deeplearning4j_tpu.telemetry.federation import (
+    KV_PREFIX,
+    SCHEMA,
+    ClusterAggregator,
+    MetricsPusher,
+    merge_snapshots,
+)
+from deeplearning4j_tpu.telemetry.registry import MetricsRegistry
+
+
+def _registry(n_reqs: int, queue_depth: float, obs) -> MetricsRegistry:
+    reg = MetricsRegistry()
+    reg.counter("serve_requests_total").inc(n_reqs)
+    reg.counter("serve_completed_total", {"reason": "eos"}).inc(n_reqs)
+    reg.gauge("serve_queue_depth").set(queue_depth)
+    for v in obs:
+        reg.histogram("serve_request_ms").observe(v)
+    return reg
+
+
+# ------------------------------------------------------- merge semantics ----
+
+class TestMergeSnapshots:
+    def test_counters_sum_per_name_and_labels(self):
+        a, b = _registry(3, 0, []), _registry(4, 0, [])
+        b.counter("serve_requests_total").inc(10)  # b: 14 total
+        merged = merge_snapshots([("a", a.snapshot()), ("b", b.snapshot())])
+        rows = {(r["name"], tuple(sorted(r["labels"].items()))): r["value"]
+                for r in merged["counters"]}
+        assert rows[("serve_requests_total", ())] == 17.0
+        # labeled counters sum per (name, labels), labels preserved
+        assert rows[("serve_completed_total",
+                     (("reason", "eos"),))] == 7.0
+
+    def test_gauges_stay_per_process(self):
+        a, b = _registry(0, 2.0, []), _registry(0, 7.0, [])
+        merged = merge_snapshots([("a", a.snapshot()), ("b", b.snapshot())])
+        rows = {(r["name"], r["labels"].get("process")): r["value"]
+                for r in merged["gauges"]}
+        # NOT averaged/overwritten: one labeled series per process — the
+        # router signal (which replica is loaded) survives the merge
+        assert rows[("serve_queue_depth", "a")] == 2.0
+        assert rows[("serve_queue_depth", "b")] == 7.0
+
+    def test_histograms_bucket_merge_exact_on_identical_bounds(self):
+        a = _registry(0, 0, [3.0, 40.0])
+        b = _registry(0, 0, [700.0])
+        merged = merge_snapshots([("a", a.snapshot()), ("b", b.snapshot())])
+        h = [r for r in merged["histograms"]
+             if r["name"] == "serve_request_ms"][0]
+        assert h["count"] == 3 and h["sum"] == 743.0
+        by_le = {x["le"]: x["count"] for x in h["buckets"]}
+        assert by_le[5.0] == 1       # only a's 3.0
+        assert by_le[50.0] == 2      # a's two
+        assert by_le[1000.0] == 3    # everything
+        assert by_le[float("inf")] == 3
+
+    def test_histogram_union_bounds_lower_bound_semantics(self):
+        """Mismatched bounds merge over the union; a source without a
+        bound contributes its cumulative count at its largest bound ≤ it
+        (documented lower bound, never an invented observation)."""
+        a, b = MetricsRegistry(), MetricsRegistry()
+        ha = a.histogram("m", buckets=(10.0, 100.0))
+        hb = b.histogram("m", buckets=(50.0,))
+        ha.observe(5.0), ha.observe(60.0)
+        hb.observe(20.0)
+        merged = merge_snapshots([("a", a.snapshot()), ("b", b.snapshot())])
+        h = merged["histograms"][0]
+        by_le = {x["le"]: x["count"] for x in h["buckets"]}
+        # union of bounds {10, 50, 100, inf}
+        assert by_le[10.0] == 1      # a's 5.0; b has no bound ≤ 10 → 0
+        assert by_le[50.0] == 2      # a cum@10 (1) + b cum@50 (1)
+        assert by_le[100.0] == 3
+        assert h["count"] == 3 and h["sum"] == 85.0
+
+    def test_empty_merge(self):
+        merged = merge_snapshots([])
+        assert merged == {"counters": [], "gauges": [], "histograms": []}
+
+
+# ------------------------------------------- live push → aggregate (TCP) ----
+
+class TestLiveFederation:
+    def test_two_live_pushed_registries_merge_and_staleness(self):
+        """Acceptance: /api/cluster-grade aggregation of ≥2 live
+        processes' registries with correct counter-sum / histogram-merge
+        semantics, and a lapsed pusher marked stale while its last-known
+        data stays in the merge."""
+        with StateTrackerServer() as server:
+            c1 = StateTrackerClient(server.address)
+            c2 = StateTrackerClient(server.address)
+            r1 = _registry(3, 2.0, [3.0])
+            r2 = _registry(4, 7.0, [700.0])
+            p1 = MetricsPusher(c1, "replica-0", registry=r1)
+            p2 = MetricsPusher(c2, "replica-1", registry=r2)
+            assert p1.push_once() and p2.push_once()
+            agg = ClusterAggregator(server.tracker, stale_after_s=0.3,
+                                    registry=MetricsRegistry())
+            view = agg.collect()
+            assert view["schema"] == SCHEMA
+            procs = {p["process"]: p for p in view["processes"]}
+            assert sorted(procs) == ["replica-0", "replica-1"]
+            assert not any(p["stale"] for p in procs.values())
+            counters = {r["name"]: r["value"]
+                        for r in view["merged"]["counters"]
+                        if not r["labels"]}
+            assert counters["serve_requests_total"] == 7.0
+            # the pusher's own health metrics federate too (a payload
+            # reflects the counters as of its snapshot, so push #2 is
+            # the first to carry pushes_total=1)
+            assert p1.push_once()
+            counters2 = {r["name"]: r["value"]
+                         for r in agg.collect()["merged"]["counters"]
+                         if not r["labels"]}
+            assert counters2["federation_pushes_total"] == 1.0
+            h = [r for r in view["merged"]["histograms"]
+                 if r["name"] == "serve_request_ms"][0]
+            assert h["count"] == 2 and h["sum"] == 703.0
+            gauges = {(r["name"], r["labels"].get("process")): r["value"]
+                      for r in view["merged"]["gauges"]}
+            assert gauges[("serve_queue_depth", "replica-0")] == 2.0
+            assert gauges[("serve_queue_depth", "replica-1")] == 7.0
+            # replica-0's heartbeat lapses; replica-1 keeps pushing
+            time.sleep(0.35)
+            p2.push_once()
+            view = agg.collect()
+            procs = {p["process"]: p for p in view["processes"]}
+            assert procs["replica-0"]["stale"] is True
+            assert procs["replica-1"]["stale"] is False
+            # stale ≠ dropped: the last-known counters still merge
+            counters = {r["name"]: r["value"]
+                        for r in view["merged"]["counters"]
+                        if not r["labels"]}
+            assert counters["serve_requests_total"] == 7.0
+            assert agg.registry.gauge("federation_stale_processes").value \
+                == 1.0
+            rec = agg.metrics_record()
+            assert rec["federation_collects_total"] == 3.0
+            assert rec["federation_processes"] == 2.0
+            c1.close(), c2.close()
+
+    def test_pusher_background_thread_cadence_and_clean_stop(self):
+        tracker = InMemoryStateTracker()
+        reg = MetricsRegistry()
+        reg.counter("serve_tokens_total").inc(5)
+        before = threading.active_count()
+        pusher = MetricsPusher(tracker, "bg", registry=reg,
+                               interval_s=0.02)
+        with pusher:
+            deadline = time.time() + 5.0
+            while (reg.counter("federation_pushes_total").value < 3
+                   and time.time() < deadline):
+                time.sleep(0.01)
+        assert reg.counter("federation_pushes_total").value >= 3
+        assert threading.active_count() == before  # joined, not leaked
+        payload = json.loads(tracker.get_kv(KV_PREFIX + "bg"))
+        assert payload["schema"] == SCHEMA and payload["process"] == "bg"
+        assert payload["seq"] >= 2  # monotone versioning
+        # stop() flushed a final push after the thread joined
+        counters = {r["name"]: r["value"]
+                    for r in payload["snapshot"]["counters"]}
+        assert counters["serve_tokens_total"] == 5.0
+        # idempotent stop / restartable start
+        pusher.stop()
+        pusher.start()
+        pusher.stop()
+        assert threading.active_count() == before
+
+    def test_push_failure_absorbed_and_counted(self):
+        class DeadTracker:
+            def put_kv(self, key, value):
+                raise ConnectionError("tracker down")
+
+        reg = MetricsRegistry()
+        pusher = MetricsPusher(DeadTracker(), "sad", registry=reg)
+        assert pusher.push_once() is False
+        assert reg.counter("federation_push_failures_total").value == 1.0
+        assert reg.gauge("federation_last_push_error").value == 1.0
+
+    def test_bad_payloads_skipped_and_counted(self):
+        tracker = InMemoryStateTracker()
+        tracker.put_kv(KV_PREFIX + "broken", "{not json")
+        tracker.put_kv(KV_PREFIX + "wrong-schema",
+                       json.dumps({"schema": "v999", "ts": time.time()}))
+        reg = _registry(1, 0, [])
+        MetricsPusher(tracker, "good", registry=reg).push_once()
+        agg = ClusterAggregator(tracker, registry=MetricsRegistry())
+        view = agg.collect()
+        assert [p["process"] for p in view["processes"]] == ["good"]
+        assert agg.registry.counter(
+            "federation_bad_payloads_total").value == 2.0
+
+    def test_kv_store_over_the_wire(self):
+        """The tracker KV extension itself: last-write-wins, prefix
+        snapshot, retry-safe idempotent classification."""
+        from deeplearning4j_tpu.scaleout.remote_tracker import _IDEMPOTENT
+
+        assert {"put_kv", "get_kv", "kv_snapshot"} <= _IDEMPOTENT
+        with StateTrackerServer() as server:
+            client = StateTrackerClient(server.address)
+            client.put_kv("a.x", "1")
+            client.put_kv("a.x", "2")  # last write wins
+            client.put_kv("a.y", "3")
+            client.put_kv("b.z", "4")
+            assert client.get_kv("a.x") == "2"
+            assert client.get_kv("missing") is None
+            assert client.get_kv("missing", "dflt") == "dflt"
+            assert client.kv_snapshot("a.") == {"a.x": "2", "a.y": "3"}
+            assert sorted(client.kv_snapshot()) == ["a.x", "a.y", "b.z"]
+            client.close()
+
+
+# -------------------------------------------------------- UI surface ----
+
+class TestClusterUi:
+    @pytest.fixture
+    def cluster(self):
+        from deeplearning4j_tpu.ui import UiServer
+
+        tracker = InMemoryStateTracker()
+        MetricsPusher(tracker, "replica-0",
+                      registry=_registry(3, 2.0, [3.0])).push_once()
+        MetricsPusher(tracker, "replica-1",
+                      registry=_registry(4, 7.0, [700.0])).push_once()
+        agg = ClusterAggregator(tracker, stale_after_s=60.0,
+                                registry=MetricsRegistry())
+        server = UiServer()
+        server.attach_federation(agg)
+        server.start(port=0)
+        yield server
+        server.stop()
+
+    def _get(self, server, path):
+        url = f"http://127.0.0.1:{server.port}{path}"
+        with urllib.request.urlopen(url) as r:
+            return r.status, r.read()
+
+    def test_api_cluster_merges_live_processes(self, cluster):
+        status, body = self._get(cluster, "/api/cluster")
+        assert status == 200
+        view = json.loads(body)
+        assert len(view["processes"]) == 2
+        assert not any(p["stale"] for p in view["processes"])
+        counters = {r["name"]: r["value"]
+                    for r in view["merged"]["counters"] if not r["labels"]}
+        assert counters["serve_requests_total"] == 7.0
+
+    def test_metrics_cluster_scope_prometheus(self, cluster):
+        status, body = self._get(cluster, "/metrics?scope=cluster")
+        text = body.decode()
+        assert status == 200
+        assert "serve_requests_total 7" in text
+        assert 'serve_queue_depth{process="replica-0"} 2' in text
+        assert 'federation_process_up{process="replica-1"} 1' in text
+        assert "# TYPE serve_request_ms histogram" in text
+
+    def test_metrics_unknown_scope_400(self, cluster):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._get(cluster, "/metrics?scope=galaxy")
+        assert e.value.code == 400
+
+    def test_api_cluster_404_without_aggregator(self):
+        from deeplearning4j_tpu.ui import UiServer
+
+        server = UiServer()
+        server.start(port=0)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                self._get(server, "/api/cluster")
+            assert e.value.code == 404
+            with pytest.raises(urllib.error.HTTPError) as e:
+                self._get(server, "/metrics?scope=cluster")
+            assert e.value.code == 404
+        finally:
+            server.stop()

@@ -475,3 +475,268 @@ def test_engine_stress_concurrent_clients_under_lockwatch(params, lockwatch):
     eng_stats = watch["locks"].get("serve.engine", {})
     assert eng_stats.get("acquires", 0) > n_clients * per_client, (
         "scheduler lock barely exercised", eng_stats)
+
+
+# -------------------------------------- request-scoped tracing (ISSUE 12) ----
+
+class TestServeTracing:
+    """The serve half of the ISSUE 12 tentpole: every request a
+    ``serve.request`` span tree, every scheduler iteration an
+    ``engine.step`` span, attribution reconstructable by the real
+    tools/trace_report.py — and tracing must not perturb decode output
+    (greedy parity) nor the steady-state 0-compile budget."""
+
+    @pytest.fixture
+    def tracer(self, tmp_path):
+        from deeplearning4j_tpu.telemetry import trace as tr
+
+        tracer = tr.Tracer("serve-test", trace_dir=str(tmp_path / "trace"))
+        prev = tr.set_tracer(tracer)
+        yield tracer
+        tr.set_tracer(prev)
+        tracer.close()
+
+    def _load(self, tracer):
+        import sys
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from tools.trace_report import load_trace_dir
+
+        return load_trace_dir(os.path.dirname(tracer.path))
+
+    def test_request_span_tree_and_attribution(self, params, tracer):
+        from tools.trace_report import serve_attribution
+
+        eng = DecodeEngine(params, H, n_slots=2, max_len=MAXLEN,
+                           serve_dtype=None, weight_version="w-test")
+        prompts = _prompts(4, seed=11)
+        reqs = [eng.submit(p, max_new_tokens=3) for p in prompts]
+        eng.run_until_idle()
+        assert all(r.done.is_set() for r in reqs)
+        spans = self._load(tracer)
+        by_name = {}
+        for sp in spans.values():
+            by_name.setdefault(sp["name"], []).append(sp)
+        # one serve.request per submit, all closed, full child set
+        assert len(by_name["serve.request"]) == 4
+        for req_span in by_name["serve.request"]:
+            assert req_span.get("end") is not None
+            kids = [sp for sp in spans.values()
+                    if sp.get("parent_id") == req_span["span_id"]]
+            kid_names = sorted(k["name"] for k in kids)
+            assert kid_names == ["serve.decode", "serve.prefill",
+                                 "serve.queue_wait", "serve.retire"]
+            # per-token accept events ride the decode span
+            decode = [k for k in kids if k["name"] == "serve.decode"][0]
+            accepts = [e for e in decode["events"] if e["name"] == "accept"]
+            assert len(accepts) == 3
+            # retire carries reason + weight forensics
+            retire = [k for k in kids if k["name"] == "serve.retire"][0]
+            assert retire["attrs"]["reason"] == "max_new_tokens"
+            assert retire["attrs"]["weight_version"] == "w-test"
+        # scheduler iterations traced with occupancy/admission accounting
+        steps = by_name["engine.step"]
+        assert steps and all(s.get("end") is not None for s in steps)
+        assert sum(s["attrs"].get("admissions", 0) for s in steps) == 4
+        assert max(s["attrs"].get("occupancy", 0) for s in steps) == 2
+        assert sum(s["attrs"].get("retired", 0) for s in steps) == 4
+        # the acceptance sum: queue+prefill+decode+gap within 1ms of the
+        # engine-measured request latency, for every request
+        rows = serve_attribution(spans)
+        assert len(rows) == 4
+        for row in rows:
+            assert row["status"] == "ok"
+            total = (row["queue_wait_ms"] + row["prefill_ms"]
+                     + row["decode_ms"] + row["gap_ms"])
+            assert abs(total - row["total_ms"]) <= 1.0, row
+            assert row["tokens"] == 3
+            assert row["weight_version"] == "w-test"
+
+    def test_queue_wait_attributed_under_contention(self, params, tracer):
+        """1 slot, 3 requests up front: the later requests' queue_wait
+        must dominate their prefill (they sat queued through the earlier
+        requests' full decode streams)."""
+        from tools.trace_report import serve_attribution
+
+        eng = DecodeEngine(params, H, n_slots=1, max_len=MAXLEN,
+                           serve_dtype=None)
+        for p in _prompts(3, seed=12):
+            eng.submit(p, max_new_tokens=4)
+        eng.run_until_idle()
+        rows = sorted(serve_attribution(self._load(tracer)),
+                      key=lambda r: r["rid"])
+        assert rows[0]["queue_wait_ms"] < rows[-1]["queue_wait_ms"]
+        assert rows[-1]["queue_wait_ms"] > rows[-1]["prefill_ms"]
+
+    def test_greedy_parity_and_zero_retrace_with_tracer_armed(
+            self, params, tracer, retrace_budget):
+        """ISSUE 12 acceptance: arming the tracer changes NOTHING about
+        the decode math (token-identical to the recompute-per-token
+        oracle) and adds NO compiles to the steady-state loop — the
+        instrumentation is host-side only."""
+        eng = DecodeEngine(params, H, n_slots=2, max_len=MAXLEN,
+                           serve_dtype=None)
+        eng.generate([1] * 5, max_new_tokens=2)   # warm buckets 8
+        eng.generate([1] * 12, max_new_tokens=2)  # and 16
+        prompts = _prompts(3, seed=13)
+        with retrace_budget(0, label="traced steady-state decode"):
+            outs = [eng.generate(p, max_new_tokens=5) for p in prompts]
+        for p, got in zip(prompts, outs):
+            assert got == _oracle_greedy(params, p, 5), p
+
+    def test_zero_cost_unconfigured(self, params):
+        """No tracer ⇒ no span objects anywhere on the request path."""
+        from deeplearning4j_tpu.telemetry import trace as tr
+
+        assert tr.get_tracer() is None
+        eng = DecodeEngine(params, H, n_slots=1, max_len=MAXLEN,
+                           serve_dtype=None)
+        req = eng.submit(_prompts(1, seed=14)[0], max_new_tokens=2)
+        eng.run_until_idle()
+        assert req.span is None and req.queue_span is None
+        assert req.decode_span is None and req.decode_ms == 0.0
+
+    def test_kill9_leaves_open_request_span_reconstructable(self, tmp_path):
+        """Acceptance: kill -9 of a serving process leaves open
+        ``serve.request`` spans the report reconstructs — the eager
+        begin records ARE the forensics, no hook runs."""
+        import signal
+        import subprocess
+        import sys
+
+        from tools.trace_report import load_trace_dir, serve_attribution
+
+        trace_dir = str(tmp_path / "trace")
+        proc = subprocess.Popen(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__),
+                          "_serve_trace_child.py"), trace_dir],
+            stdout=subprocess.PIPE, text=True)
+        try:
+            line = proc.stdout.readline()
+            assert line.strip() == "READY", line
+            proc.send_signal(signal.SIGKILL)
+        finally:
+            proc.wait(timeout=30)
+            proc.stdout.close()
+        spans = load_trace_dir(trace_dir)
+        rows = serve_attribution(spans)
+        assert len(rows) == 1
+        row = rows[0]
+        assert row["status"] == "open"
+        assert row["rid"] == 0
+        assert row["process"] == "serve-victim"
+        # the open decode child pins that the victim died mid-stream
+        open_names = {sp["name"] for sp in spans.values()
+                      if sp.get("end") is None}
+        assert "serve.request" in open_names
+        assert "serve.decode" in open_names
+
+    def test_http_traceparent_end_to_end_tree(self, params, tracer):
+        """One trace tree spans loadgen → HTTP server → engine: the HTTP
+        loadgen driver emits traceparent, UiServer parents http.request
+        under it, and the engine's serve.request tree hangs beneath —
+        all sharing the loadgen root's trace id."""
+        from deeplearning4j_tpu.serve.loadgen import run_open_loop_http
+        from deeplearning4j_tpu.ui import UiServer
+
+        eng = DecodeEngine(params, H, n_slots=2, max_len=MAXLEN,
+                           serve_dtype=None)
+        eng.start()
+        server = UiServer()
+        server.attach_engine(eng)
+        server.start(port=0)
+        try:
+            rep = run_open_loop_http(
+                f"http://127.0.0.1:{server.port}", _prompts(2, seed=15),
+                rate_rps=100.0, max_new_tokens=3)
+            assert rep.completed == 2
+            assert rep.latency_p99_ms >= rep.latency_p50_ms > 0
+        finally:
+            server.stop()
+            eng.stop()
+        spans = self._load(tracer)
+        roots = [sp for sp in spans.values()
+                 if sp["name"] == "loadgen.request"]
+        assert len(roots) == 2
+        for root in roots:
+            tree = [sp for sp in spans.values()
+                    if sp.get("trace_id") == root["trace_id"]]
+            names = {sp["name"] for sp in tree}
+            # loadgen → http → serve.request → children, ONE trace id
+            assert {"loadgen.request", "http.request", "serve.request",
+                    "serve.prefill", "serve.decode",
+                    "serve.retire"} <= names
+            http = [sp for sp in tree if sp["name"] == "http.request"][0]
+            assert http["parent_id"] == root["span_id"]
+            sreq = [sp for sp in tree if sp["name"] == "serve.request"][0]
+            assert sreq["parent_id"] == http["span_id"]
+
+
+# --------------------------------------- in-flight request ages (ISSUE 12) ----
+
+def test_stats_reports_in_flight_request_ages(params):
+    """ISSUE 12 satellite: a stuck request is visible from /api/serve as
+    a growing queued_s/running_s instead of only as a hung client."""
+    import time as _time
+
+    eng = DecodeEngine(params, H, n_slots=1, max_len=MAXLEN,
+                       serve_dtype=None)
+    prompts = _prompts(3, seed=16)
+    reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    _time.sleep(0.02)
+    st = eng.stats()
+    flight = {f["rid"]: f for f in st["in_flight"]}
+    assert sorted(flight) == [r.rid for r in reqs]
+    assert all(f["state"] == "queued" for f in flight.values())
+    assert all(f["queued_s"] >= 0.02 for f in flight.values())
+    assert all(f["tokens"] == 0 for f in flight.values())
+    eng.step()  # admit rid 0 into the single slot + first decode
+    st = eng.stats()
+    flight = {f["rid"]: f for f in st["in_flight"]}
+    running = flight[reqs[0].rid]
+    assert running["state"] == "running" and running["slot"] == 0
+    assert running["tokens"] >= 1
+    assert running["running_s"] >= 0.0
+    assert running["prompt_len"] == len(prompts[0])
+    # the other two still queued, ages still growing
+    assert flight[reqs[1].rid]["state"] == "queued"
+    eng.run_until_idle()
+    assert eng.stats()["in_flight"] == []
+
+
+def test_stats_and_retire_carry_weight_version(params, tmp_path):
+    from deeplearning4j_tpu.models.transformer_lm import lm_checkpoint_meta
+    from deeplearning4j_tpu.scaleout.ckpt.checkpointer import Checkpointer
+
+    root = str(tmp_path / "ckpt")
+    Checkpointer(root).save(7, {"params": params},
+                            meta=lm_checkpoint_meta(params, H))
+    eng = DecodeEngine.from_checkpoint(root, max_len=MAXLEN,
+                                       serve_dtype=None)
+    assert eng.weight_version == "ckpt-step-7"
+    assert eng.stats()["weight_version"] == "ckpt-step-7"
+
+
+def test_engine_metrics_record_flat_keys(params):
+    """Every serve_* registry instrument reaches the step-log record the
+    telemetry report renders (histograms as _count/_sum, labeled
+    counters summed) — the contract the ISSUE 12 meta-test leans on."""
+    from deeplearning4j_tpu.telemetry.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    eng = DecodeEngine(params, H, n_slots=1, max_len=MAXLEN,
+                       serve_dtype=None, registry=reg)
+    eng.generate(_prompts(1, seed=17)[0], max_new_tokens=2)
+    rec = eng.metrics_record()
+    assert rec["serve_requests_total"] == 1.0
+    assert rec["serve_tokens_total"] == 2.0
+    assert rec["serve_completed_total"] == 1.0  # labels summed
+    assert rec["serve_request_ms_count"] == 1.0
+    assert rec["serve_request_ms_sum"] > 0
+    # EVERY serve_* name in the registry surfaces in the record
+    snap = reg.snapshot()
+    names = {r["name"] for kind in ("counters", "gauges", "histograms")
+             for r in snap[kind] if r["name"].startswith("serve_")}
+    for name in names:
+        assert name in rec or f"{name}_count" in rec, name

@@ -926,3 +926,108 @@ class TestLockwatchReport:
             w.write(1, loss=0.5)
         assert "lockwatch" not in summarize_step_log(read_step_log(path))
         assert "lockwatch (per watched lock)" not in self._run_report(path)
+
+
+class TestServeFederationReport:
+    """ISSUE 12 satellite + meta-test: every ``serve_*`` and
+    ``federation_*`` registry metric name is rendered by
+    tools/telemetry_report.py, silent-when-absent pinned both ways —
+    riding the ISSUE 11 lockwatch pattern, so a future metric under
+    either prefix can't ship unrendered (registry.flat_record is the one
+    flattening every metrics_record() goes through)."""
+
+    def _run_report(self, path):
+        import subprocess
+        import sys as _sys
+
+        out = subprocess.run(
+            [_sys.executable,
+             os.path.join(REPO, "tools", "telemetry_report.py"), path],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        return out.stdout
+
+    def _registry_names(self, registry, prefix):
+        snap = registry.snapshot()
+        return {r["name"] for kind in ("counters", "gauges", "histograms")
+                for r in snap[kind] if r["name"].startswith(prefix)}
+
+    def test_wall_ms_summary_includes_p99(self, tmp_path):
+        path = str(tmp_path / "steps.jsonl")
+        with StepLogWriter(path) as w:
+            for i in range(100):
+                w.write(i, wall_ms=float(i + 1))
+        s = summarize_step_log(read_step_log(path))
+        assert s["wall_ms"]["p50"] == 50.0
+        assert s["wall_ms"]["p95"] == 95.0
+        assert s["wall_ms"]["p99"] == 99.0
+        assert "p50 / p95 / p99 / mean" in self._run_report(path)
+
+    def test_meta_every_serve_metric_rendered(self, tmp_path):
+        """Exercise a REAL engine, take its live registry names, and pin
+        each one into the rendered report output."""
+        import jax
+
+        from deeplearning4j_tpu.models.transformer_lm import init_lm_params
+        from deeplearning4j_tpu.serve import DecodeEngine
+
+        reg = MetricsRegistry()
+        params = init_lm_params(jax.random.PRNGKey(0), 31, 8, 2, 2, 16,
+                                n_layers=1)
+        eng = DecodeEngine(params, 2, n_slots=1, max_len=16,
+                           serve_dtype=None, registry=reg)
+        eng.generate([1, 2, 3], max_new_tokens=2)
+        names = self._registry_names(reg, "serve_")
+        assert names  # the engine really registered serve metrics
+        rec = eng.metrics_record()
+        path = str(tmp_path / "steps.jsonl")
+        with StepLogWriter(path) as w:
+            w.write(0, loss=1.0, **rec)
+        summary = summarize_step_log(read_step_log(path))
+        text = self._run_report(path)
+        assert "serve metrics (registry)" in text
+        for name in sorted(names):
+            assert (name in summary["serve"]
+                    or f"{name}_count" in summary["serve"]), name
+            assert name in text, f"{name} not rendered by telemetry_report"
+
+    def test_meta_every_federation_metric_rendered(self, tmp_path):
+        from deeplearning4j_tpu.scaleout.statetracker import (
+            InMemoryStateTracker,
+        )
+        from deeplearning4j_tpu.telemetry.federation import (
+            ClusterAggregator,
+            MetricsPusher,
+        )
+
+        tracker = InMemoryStateTracker()
+        reg = MetricsRegistry()
+        reg.counter("serve_requests_total").inc()
+        pusher = MetricsPusher(tracker, "p0", registry=reg)
+        pusher.push_once()
+        agg = ClusterAggregator(tracker, registry=reg)
+        agg.collect()
+        names = self._registry_names(reg, "federation_")
+        assert names
+        rec = agg.metrics_record()
+        path = str(tmp_path / "steps.jsonl")
+        with StepLogWriter(path) as w:
+            w.write(0, loss=1.0, **rec)
+        summary = summarize_step_log(read_step_log(path))
+        text = self._run_report(path)
+        assert "federation metrics (registry)" in text
+        for name in sorted(names):
+            assert (name in summary["federation"]
+                    or f"{name}_count" in summary["federation"]), name
+            assert name in text, f"{name} not rendered by telemetry_report"
+
+    def test_silent_without_serve_or_federation_metrics(self, tmp_path):
+        path = str(tmp_path / "steps.jsonl")
+        with StepLogWriter(path) as w:
+            w.write(0, loss=1.0)
+            w.write(1, loss=0.5)
+        summary = summarize_step_log(read_step_log(path))
+        assert "serve" not in summary and "federation" not in summary
+        text = self._run_report(path)
+        assert "serve metrics" not in text
+        assert "federation metrics" not in text

@@ -408,3 +408,57 @@ class TestBenchReport:
              "--dir", str(tmp_path)],
             capture_output=True, text=True, timeout=60, cwd=REPO)
         assert out.returncode == 2
+
+
+# ---------------------------------------- W3C traceparent (ISSUE 12) ----
+
+class TestTraceparent:
+    """The HTTP-propagation half of the serve tracing: header format,
+    parse tolerance (a malformed header is IGNORED per the W3C spec —
+    the request must proceed as a fresh root), and that a caller-minted
+    32-hex trace id flows through the span model unchanged."""
+
+    def test_format_pads_internal_ids_to_w3c_width(self):
+        hdr = tr.format_traceparent({"trace_id": "ab" * 8,
+                                     "span_id": "cd" * 4})
+        version, trace_id, span_id, flags = hdr.split("-")
+        assert version == "00" and flags == "01"
+        assert len(trace_id) == 32 and trace_id.endswith("ab" * 8)
+        assert len(span_id) == 16 and span_id.endswith("cd" * 4)
+
+    def test_parse_format_round_trip(self):
+        ctx = {"trace_id": "a" * 32, "span_id": "b" * 16}
+        assert tr.parse_traceparent(tr.format_traceparent(ctx)) == ctx
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "garbage", "00-short-ffffffffffffffff-01",
+        "00-" + "g" * 32 + "-" + "f" * 16 + "-01",   # non-hex
+        "00-" + "0" * 32 + "-" + "f" * 16 + "-01",   # all-zero trace id
+        "00-" + "f" * 32 + "-" + "0" * 16 + "-01",   # all-zero span id
+        "ff-" + "f" * 32 + "-" + "f" * 16 + "-01",   # forbidden version
+        "0-" + "f" * 32 + "-" + "f" * 16 + "-01",    # short version
+        "00-" + "f" * 32 + "-" + "f" * 16,           # missing flags
+    ])
+    def test_malformed_headers_parse_to_none(self, bad):
+        assert tr.parse_traceparent(bad) is None
+
+    def test_future_version_with_extra_fields_accepted(self):
+        # the spec: parse version 01+ headers by the 00 rules, ignoring
+        # trailing fields
+        hdr = "01-" + "a" * 32 + "-" + "b" * 16 + "-01-extra"
+        assert tr.parse_traceparent(hdr) == {"trace_id": "a" * 32,
+                                             "span_id": "b" * 16}
+
+    def test_remote_trace_id_flows_through_spans(self, tmp_path,
+                                                 no_global_tracer):
+        tracer = tr.Tracer("srv", trace_dir=str(tmp_path))
+        ctx = tr.parse_traceparent("00-" + "a" * 32 + "-" + "b" * 16 + "-01")
+        with tracer.span("http.request", parent=ctx) as sp:
+            assert sp.trace_id == "a" * 32
+            assert sp.parent_id == "b" * 16
+            # the response header regenerates losslessly at full width
+            assert tr.format_traceparent(sp.context()) == \
+                f"00-{'a' * 32}-{sp.span_id}-01"
+        tracer.close()
+        recs = _read_records(str(tmp_path / "spans_srv.jsonl"))
+        assert recs[0]["trace_id"] == "a" * 32

@@ -339,3 +339,132 @@ def test_api_trace_endpoint(server, tmp_path):
         assert len(json.loads(body)["recent"]) == 1
     finally:
         tr.set_tracer(prev)
+
+
+# ------------------------------------ traceparent propagation (ISSUE 12) ----
+
+class TestTraceparentPropagation:
+    """Raw http.client POSTs (full header control) pinning the W3C
+    propagation contract of /api/generate: an inbound traceparent
+    parents the handler span (and the engine's serve.request under it),
+    the response carries the trace id both as JSON and as a traceparent
+    header, a malformed header is TOLERATED (the request succeeds as a
+    fresh root — never a 400), and with tracing off nothing changes."""
+
+    @pytest.fixture
+    def tracer(self, tmp_path):
+        from deeplearning4j_tpu.telemetry import trace as tr
+
+        tracer = tr.Tracer("ui-test", trace_dir=str(tmp_path / "trace"))
+        prev = tr.set_tracer(tracer)
+        yield tracer
+        tr.set_tracer(prev)
+        tracer.close()
+
+    def _post_raw(self, server, body: bytes, headers: dict):
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=30)
+        try:
+            all_headers = {"Content-Type": "application/json",
+                           "Content-Length": str(len(body)), **headers}
+            conn.request("POST", "/api/generate", body=body,
+                         headers=all_headers)
+            resp = conn.getresponse()
+            return resp.status, dict(resp.getheaders()), resp.read()
+        finally:
+            conn.close()
+
+    def _spans(self, tracer):
+        import os
+        import sys
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from tools.trace_report import load_trace_dir
+
+        return load_trace_dir(os.path.dirname(tracer.path))
+
+    def test_inbound_traceparent_parents_the_tree(self, server, lm_engine,
+                                                  tracer):
+        server.attach_engine(lm_engine)
+        caller_trace, caller_span = "ab" * 16, "cd" * 8
+        hdr = f"00-{caller_trace}-{caller_span}-01"
+        status, headers, body = self._post_raw(
+            server, json.dumps({"prompt": [1, 2], "max_new_tokens": 2}
+                               ).encode(), {"traceparent": hdr})
+        assert status == 200
+        out = json.loads(body)
+        # the response carries the CALLER's trace id (JSON + header)
+        assert out["trace_id"] == caller_trace
+        resp_tp = {k.lower(): v for k, v in headers.items()}["traceparent"]
+        assert resp_tp.startswith(f"00-{caller_trace}-")
+        spans = self._spans(tracer)
+        http = [sp for sp in spans.values()
+                if sp["name"] == "http.request"][0]
+        assert http["trace_id"] == caller_trace
+        assert http["parent_id"] == caller_span
+        assert http["attrs"]["remote_trace"] is True
+        sreq = [sp for sp in spans.values()
+                if sp["name"] == "serve.request"][0]
+        assert sreq["trace_id"] == caller_trace
+        assert sreq["parent_id"] == http["span_id"]
+
+    def test_without_traceparent_fresh_root(self, server, lm_engine,
+                                            tracer):
+        server.attach_engine(lm_engine)
+        status, headers, body = self._post_raw(
+            server, json.dumps({"prompt": [1], "max_new_tokens": 2}
+                               ).encode(), {})
+        assert status == 200
+        out = json.loads(body)
+        assert len(out["trace_id"]) == 32  # fresh W3C-width root
+        spans = self._spans(tracer)
+        http = [sp for sp in spans.values()
+                if sp["name"] == "http.request"][0]
+        assert http["parent_id"] is None
+        assert http["attrs"]["remote_trace"] is False
+
+    def test_malformed_traceparent_tolerated_not_400(self, server,
+                                                     lm_engine, tracer):
+        server.attach_engine(lm_engine)
+        for bad in ("garbage", "00-zz-xx-01",
+                    "00-" + "0" * 32 + "-" + "1" * 16 + "-01"):
+            status, _headers, body = self._post_raw(
+                server, json.dumps({"prompt": [1], "max_new_tokens": 1}
+                                   ).encode(), {"traceparent": bad})
+            assert status == 200, bad  # ignored per W3C, never rejected
+            out = json.loads(body)
+            assert out["trace_id"] not in bad
+            assert out["n"] == 1
+
+    def test_tracing_off_no_trace_fields(self, server, lm_engine):
+        from deeplearning4j_tpu.telemetry import trace as tr
+
+        assert tr.get_tracer() is None
+        server.attach_engine(lm_engine)
+        status, headers, body = self._post_raw(
+            server, json.dumps({"prompt": [1], "max_new_tokens": 1}
+                               ).encode(),
+            {"traceparent": "00-" + "a" * 32 + "-" + "b" * 16 + "-01"})
+        assert status == 200
+        assert "trace_id" not in json.loads(body)
+        assert "traceparent" not in {k.lower() for k in headers}
+
+
+def test_api_serve_exposes_in_flight_ages(server, lm_engine):
+    """ISSUE 12 satellite: /api/serve shows per-request queued_s /
+    running_s / tokens so a stuck request is visible from the UI."""
+    server.attach_engine(lm_engine)
+    req = lm_engine.submit([1, 2, 3], max_new_tokens=8)
+    _, body = _get(server, "/api/serve")
+    stats = json.loads(body)
+    flight = stats["in_flight"]
+    assert len(flight) == 1
+    assert flight[0]["rid"] == req.rid
+    assert flight[0]["state"] == "queued"
+    assert flight[0]["queued_s"] >= 0.0
+    assert flight[0]["prompt_len"] == 3
+    lm_engine.run_until_idle()
+    _, body = _get(server, "/api/serve")
+    assert json.loads(body)["in_flight"] == []

@@ -28,11 +28,12 @@ rows are LOWER-IS-BETTER: ``--fail-on-regression`` also trips when one of
 them GROWS past the threshold — a PR fattening the compiled step's
 footprint fails the gate before it ever runs on a chip.
 
-ISSUE 10: stage details carrying a ``latency`` block (the serving bench's
-``serve_detail.latency`` — p50/p95/mean milliseconds under the open-loop
-traffic generator) contribute ``<stage>_latency_{p50,p95,mean}_ms`` rows,
-also LOWER-IS-BETTER — serving-latency growth past the threshold trips
-``--fail-on-regression`` exactly like a throughput drop.
+ISSUE 10/12: stage details carrying a ``latency`` block (the serving
+bench's ``serve_detail.latency`` — p50/p95/p99/mean milliseconds under
+the open-loop traffic generator) contribute
+``<stage>_latency_{p50,p95,p99,mean}_ms`` rows, also LOWER-IS-BETTER —
+serving-latency growth past the threshold trips ``--fail-on-regression``
+exactly like a throughput drop.
 """
 
 from __future__ import annotations
@@ -55,7 +56,7 @@ _METRIC_RE = re.compile(
 # ISSUE 10 serving-latency rows)
 _LOWER_IS_BETTER_RE = re.compile(
     r"_profile_(?:peak_bytes|collective_bytes)$"
-    r"|_latency_(?:p50|p95|mean)_ms$")
+    r"|_latency_(?:p50|p95|p99|mean)_ms$")
 # recovery regex for a truncated tail: top-level "key": number pairs
 _TAIL_PAIR_RE = re.compile(
     r'"([a-z0-9_]+)":\s*(-?[0-9]+(?:\.[0-9]+)?(?:[eE][-+]?[0-9]+)?)')
@@ -99,8 +100,10 @@ def _profile_metrics(detail: Dict) -> Dict[str, float]:
 
 def _latency_metrics(detail: Dict) -> Dict[str, float]:
     """Serving-latency rows from stage details carrying a ``latency``
-    block (ISSUE 10): ``<stage>_detail.latency.{p50_ms,p95_ms,mean_ms}``
-    → ``<stage>_latency_{p50,p95,mean}_ms`` — tracked LOWER-IS-BETTER."""
+    block (ISSUE 10; p99 added by ISSUE 12 — the tail the SLO is written
+    against): ``<stage>_detail.latency.{p50_ms,p95_ms,p99_ms,mean_ms}``
+    → ``<stage>_latency_{p50,p95,p99,mean}_ms`` — tracked
+    LOWER-IS-BETTER."""
     out: Dict[str, float] = {}
     for key, val in detail.items():
         if not key.endswith("_detail") or not isinstance(val, dict):
@@ -111,6 +114,7 @@ def _latency_metrics(detail: Dict) -> Dict[str, float]:
         stage = key[: -len("_detail")]
         for src, metric in (("p50_ms", "latency_p50_ms"),
                             ("p95_ms", "latency_p95_ms"),
+                            ("p99_ms", "latency_p99_ms"),
                             ("mean_ms", "latency_mean_ms")):
             v = lat.get(src)
             if isinstance(v, (int, float)):

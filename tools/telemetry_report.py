@@ -33,8 +33,10 @@ def format_report(summary: dict, path: str) -> str:
     rows = [("steps", str(summary.get("steps", 0)))]
     wall = summary.get("wall_ms")
     if wall:
-        rows.append(("wall ms (p50 / p95 / mean)",
-                     f"{wall['p50']} / {wall['p95']} / {wall['mean']}"))
+        # p99 (ISSUE 12): older summaries may predate it — render "-"
+        rows.append(("wall ms (p50 / p95 / p99 / mean)",
+                     f"{wall['p50']} / {wall['p95']} / "
+                     f"{wall.get('p99', '-')} / {wall['mean']}"))
     if "tokens_per_sec_mean" in summary:
         rows.append(("tokens/s (mean)", str(summary["tokens_per_sec_mean"])))
     for key in ("loss", "score", "grad_norm", "param_norm", "update_ratio"):
@@ -77,6 +79,20 @@ def format_report(summary: dict, path: str) -> str:
         for flag in ("lockwatch_cycles", "lockwatch_watchdog_dumps"):
             if watch.get(flag):
                 lines.append(f"!! {flag}: {watch[flag]:.0f}")
+    # serve / federation registry metrics (ISSUE 12): one row per metric
+    # when the run carried serve_* / federation_* keys
+    # (registry.flat_record via the subsystem metrics_record()s); silent
+    # otherwise — both directions pinned by the ISSUE 12 meta-test, so a
+    # new metric under either prefix can never ship unrendered
+    for block_key, title in (("serve", "serve metrics (registry)"),
+                             ("federation",
+                              "federation metrics (registry)")):
+        block = summary.get(block_key)
+        if block:
+            bw = max(len(k) for k in block)
+            lines += ["", title]
+            lines += [f"  {k:<{bw}}  {block[k]:g}"
+                      for k in sorted(block)]
     if bad:
         lines.append(
             f"WARNING: {sum(bad.values())} non-finite metric value(s) in "

@@ -19,11 +19,24 @@ spans (an unmatched begin — a process that died mid-span — becomes an
 - partial rounds reconstructed from open spans (a kill -9 run shows the
   round the victim died in, with the spans it never closed);
 - ``--chrome``: a Chrome trace-event JSON export (load in
-  ``chrome://tracing`` / Perfetto) with one row per process.
+  ``chrome://tracing`` / Perfetto) with one row per process;
+
+and, when the trace carries serving spans (ISSUE 12 — the decode
+engine's ``serve.request`` trees and ``engine.step`` scheduler spans):
+
+- the **per-request latency-attribution table**: queue_wait / prefill /
+  decode / scheduler-gap milliseconds per request (the components sum to
+  the request latency by construction — gap is the time a request sat
+  admitted but outside its own dispatches), retire reason, weight
+  version; requests whose process died mid-flight (kill -9) appear as
+  ``open`` rows reconstructed from their eager begin records;
+- a **slot-occupancy Gantt** in the Chrome export: per-slot rows
+  (``serve.prefill``/``serve.decode`` spans land on ``tid = slot``) plus
+  a ``slot_occupancy`` counter track from the ``engine.step`` spans.
 
 The aggregation is importable (``load_trace_dir`` / ``build_timeline`` /
-``chrome_trace``) so bench.py's traced-elastic stage and the fault tests
-use the exact same reconstruction this CLI prints.
+``serve_attribution`` / ``chrome_trace``) so bench.py's traced stages and
+the fault tests use the exact same reconstruction this CLI prints.
 """
 
 from __future__ import annotations
@@ -194,10 +207,103 @@ def build_timeline(spans: Dict[str, Dict]) -> Dict:
             "n_open": n_open, "errors": errors, "rounds": out_rounds}
 
 
+def serve_attribution(spans: Dict[str, Dict]) -> List[Dict]:
+    """Per-request latency attribution from ``serve.request`` trees
+    (ISSUE 12). Completed requests carry the exact attribution the engine
+    stamped at retire (queue_wait + prefill + decode + gap ≡ latency);
+    requests cut short by a dead process surface as ``status: "open"``
+    rows with whatever their children's begin/end records pin down."""
+    children: Dict[str, List[Dict]] = {}
+    for sp in spans.values():
+        pid = sp.get("parent_id")
+        if pid:
+            children.setdefault(pid, []).append(sp)
+
+    def child_dur(req_span: Dict, name: str):
+        for c in children.get(req_span["span_id"], []):
+            if c.get("name") == name:
+                return c.get("dur_ms"), c
+        return None, None
+
+    rows: List[Dict] = []
+    for sp in spans.values():
+        if sp.get("name") != "serve.request":
+            continue
+        attrs = sp.get("attrs", {})
+        is_open = sp.get("end") is None
+        queue_ms = attrs.get("queue_wait_ms")
+        prefill_ms = attrs.get("prefill_ms")
+        decode_ms = attrs.get("decode_ms")
+        if queue_ms is None:
+            queue_ms = child_dur(sp, "serve.queue_wait")[0]
+        if prefill_ms is None:
+            prefill_ms = child_dur(sp, "serve.prefill")[0]
+        if decode_ms is None:
+            dms, dspan = child_dur(sp, "serve.decode")
+            decode_ms = (dspan.get("attrs", {}).get("decode_ms")
+                         if dspan is not None else None) or dms
+        total_ms = attrs.get("latency_ms", sp.get("dur_ms"))
+        gap_ms = attrs.get("gap_ms")
+        if gap_ms is None and None not in (total_ms, queue_ms, prefill_ms,
+                                           decode_ms):
+            gap_ms = round(total_ms - queue_ms - prefill_ms - decode_ms, 3)
+        rows.append({
+            "rid": attrs.get("rid"),
+            "trace_id": sp.get("trace_id"),
+            "process": sp.get("process"),
+            "status": "open" if is_open else sp.get("status", "ok"),
+            "start": sp.get("start"),
+            "queue_wait_ms": queue_ms,
+            "prefill_ms": prefill_ms,
+            "decode_ms": decode_ms,
+            "gap_ms": gap_ms,
+            "total_ms": total_ms,
+            "tokens": attrs.get("tokens"),
+            "finish_reason": attrs.get("finish_reason"),
+            "weight_version": attrs.get("weight_version"),
+        })
+    rows.sort(key=lambda r: (r.get("start") or 0.0,
+                             r.get("rid") if r.get("rid") is not None
+                             else -1))
+    return rows
+
+
+def render_serve_text(rows: List[Dict]) -> str:
+    """The per-request attribution table (appended to the CLI output when
+    the trace carries serving spans)."""
+    def fmt(v, w):
+        return f"{v:>{w}.2f}" if isinstance(v, (int, float)) else f"{'-':>{w}}"
+
+    hdr = (f"{'rid':>5}  {'status':<7}  {'queue':>8}  {'prefill':>8}  "
+           f"{'decode':>8}  {'gap':>8}  {'total':>8}  {'tok':>4}  "
+           f"{'reason':<14}  weights")
+    lines = ["", f"serve requests — latency attribution (ms), "
+             f"{len(rows)} request(s), "
+             f"{sum(1 for r in rows if r['status'] == 'open')} open",
+             hdr, "-" * len(hdr)]
+    for r in rows:
+        rid = r["rid"] if r["rid"] is not None else "?"
+        tok = r["tokens"] if r["tokens"] is not None else "-"
+        lines.append(
+            f"{rid:>5}  {r['status']:<7}  {fmt(r['queue_wait_ms'], 8)}  "
+            f"{fmt(r['prefill_ms'], 8)}  {fmt(r['decode_ms'], 8)}  "
+            f"{fmt(r['gap_ms'], 8)}  {fmt(r['total_ms'], 8)}  {tok:>4}  "
+            f"{str(r['finish_reason'] or '-'):<14}  "
+            f"{r['weight_version'] or '-'}")
+    return "\n".join(lines)
+
+
 def chrome_trace(spans: Dict[str, Dict]) -> Dict:
     """Chrome trace-event JSON (the ``chrome://tracing`` / Perfetto
     format): one "X" complete event per span in µs, one row per process,
-    open spans extended to the latest timestamp seen and flagged."""
+    open spans extended to the latest timestamp seen and flagged.
+
+    Serving traces get a slot-occupancy Gantt (ISSUE 12): spans carrying
+    a ``slot`` attribute (``serve.prefill``/``serve.decode``) land on
+    ``tid = 1 + slot`` — one named row per cache slot, so the per-slot
+    residency of the continuous-batching scheduler reads directly off
+    the timeline — and every ``engine.step`` span contributes a
+    ``slot_occupancy`` counter sample (ph "C")."""
     processes = sorted({sp.get("process") or "?" for sp in spans.values()})
     pid_of = {p: i for i, p in enumerate(processes)}
     latest = max((sp.get("end") or sp.get("start") or 0.0
@@ -207,6 +313,7 @@ def chrome_trace(spans: Dict[str, Dict]) -> Dict:
          "args": {"name": p}}
         for p in processes
     ]
+    slot_rows = set()  # (pid, tid) pairs needing a thread_name meta event
     for sp in sorted(spans.values(), key=lambda s: s.get("start") or 0.0):
         start = sp.get("start")
         if start is None:
@@ -221,13 +328,28 @@ def chrome_trace(spans: Dict[str, Dict]) -> Dict:
             args["open"] = True
         if sp.get("error"):
             args["error"] = sp["error"]
+        pid = pid_of[sp.get("process") or "?"]
+        tid = 0
+        slot = sp.get("attrs", {}).get("slot")
+        if isinstance(slot, int) and slot >= 0:
+            tid = 1 + slot
+            slot_rows.add((pid, tid))
         events.append({
             "name": sp.get("name") or "?", "ph": "X",
             "ts": round(start * 1e6, 1),
             "dur": round(max(0.0, (end - start)) * 1e6, 1),
-            "pid": pid_of[sp.get("process") or "?"], "tid": 0,
+            "pid": pid, "tid": tid,
             "args": args,
         })
+        if sp.get("name") == "engine.step" and "occupancy" in args:
+            events.append({
+                "name": "slot_occupancy", "ph": "C",
+                "ts": round(start * 1e6, 1), "pid": pid, "tid": 0,
+                "args": {"occupancy": args["occupancy"]},
+            })
+    for pid, tid in sorted(slot_rows):
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": tid, "args": {"name": f"slot {tid - 1}"}})
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
@@ -274,14 +396,20 @@ def main(argv=None) -> int:
               "(expected spans_*.jsonl / flightrec_*.json)", file=sys.stderr)
         return 2
     timeline = build_timeline(spans)
+    serve_rows = serve_attribution(spans)
     if args.chrome:
         with open(args.chrome, "w") as fh:
             json.dump(chrome_trace(spans), fh)
         print(f"chrome trace written: {args.chrome}", file=sys.stderr)
     if args.json:
+        if serve_rows:
+            timeline = dict(timeline, serve_requests=serve_rows)
         print(json.dumps(timeline, indent=1))
     else:
-        print(render_text(timeline, args.trace_dir))
+        out = render_text(timeline, args.trace_dir)
+        if serve_rows:
+            out += "\n" + render_serve_text(serve_rows)
+        print(out)
     return 0
 
 
