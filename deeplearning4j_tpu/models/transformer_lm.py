@@ -370,6 +370,116 @@ def shard_lm_batch(tokens: Array, targets: Array, mesh: Mesh) -> tuple:
     return jax.device_put(tokens, sh), jax.device_put(targets, sh)
 
 
+def lm_update_sharding(mesh: Mesh):
+    """The flagship's ZeRO update-sharding descriptor on ``mesh``
+    (optimize/updaters.ZeroSharding): moments shard over the "data" axis;
+    expert leaves keep their (layer, expert) prefix so the dp shard nests
+    INSIDE the expert shard — moments stay placed exactly like their
+    params on the expert axis, and the dp axis splits what was
+    replicated."""
+    from deeplearning4j_tpu.optimize.updaters import ZeroSharding
+
+    names = mesh.axis_names
+    if DATA_AXIS not in names:
+        raise ValueError(
+            f"update_sharding='sharded' needs the {DATA_AXIS!r} axis on "
+            f"the mesh (got {names}) — there is no dp axis to shard the "
+            "update over")
+    if EXPERT_AXIS in names:
+        prefix_fn = lambda ks: ((None, EXPERT_AXIS)  # noqa: E731
+                                if "['experts']" in ks else ())
+    else:
+        prefix_fn = lambda ks: ()  # noqa: E731
+    return ZeroSharding(mesh, DATA_AXIS, prefix_fn)
+
+
+def init_lm_opt_state(optimizer, params, mesh: Optional[Mesh] = None):
+    """Optimizer-state constructor matching what the flagship steps
+    expect: param-mirroring moments (replicated mode — expert leaves come
+    out expert-sharded because the zeros are placed with each param
+    leaf's own sharding) or the dp-partitioned ZeRO layout (sharded
+    mode, ``mesh`` required). Returns ``{"m", "v", "count"}``."""
+    from deeplearning4j_tpu.optimize.updaters import (
+        OptimizerConfig,
+        init_opt_state,
+    )
+
+    cfg = OptimizerConfig.coerce(optimizer)
+    if cfg is None:
+        raise ValueError("init_lm_opt_state needs an optimizer "
+                         "(name or OptimizerConfig)")
+    zero = None
+    if cfg.sharded:
+        if mesh is None:
+            raise ValueError(
+                "update_sharding='sharded' needs a mesh with a dp axis — "
+                "single-device steps run the replicated update")
+        zero = lm_update_sharding(mesh)
+    return init_opt_state(cfg, params, zero)
+
+
+def _make_opt_step(loss_fn, lr: float, with_metrics: bool, optimizer,
+                   zero, donate: bool = False, guard=None, profile=None,
+                   profile_label: str = "lm_step"):
+    """The optimizer-threaded twin of ``_make_sgd_step``:
+    ``step(params, opt_state, tokens, targets) -> (new_params,
+    new_opt_state, loss[, metrics/guard block])``. The loss+grad graph is
+    IDENTICAL to the SGD step's — only the update differs — and the
+    moments are donated alongside the params (``donate=True``), threaded
+    through the guard skip-select bitwise, and updated in the ZeRO
+    layout when ``zero`` is set (optimize/updaters.opt_update)."""
+    from deeplearning4j_tpu.optimize.updaters import (
+        guarded_opt_update,
+        opt_update,
+    )
+
+    donate_argnums = (0, 1) if donate else ()
+
+    def _seam(step):
+        from deeplearning4j_tpu.telemetry.xprofile import maybe_profiled
+
+        return maybe_profiled(step, profile, profile_label)
+
+    if not with_metrics:
+        @partial(jax.jit, donate_argnums=donate_argnums)
+        def step(params, opt_state, tokens, targets):
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens,
+                                                      targets)
+            if guard is None:
+                new_params, new_state = opt_update(
+                    optimizer, params, grads, opt_state, lr, zero=zero)
+                return new_params, new_state, loss
+            new_params, new_state, gm = guarded_opt_update(
+                params, grads, opt_state, loss, lr, optimizer, guard,
+                zero=zero)
+            return new_params, new_state, loss, gm
+
+        return _seam(step)
+
+    from deeplearning4j_tpu.telemetry.metrics import train_step_metrics
+
+    @partial(jax.jit, donate_argnums=donate_argnums)
+    def step(params, opt_state, tokens, targets):
+        (loss, metrics), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, tokens, targets)
+        if guard is None:
+            new_params, new_state, om = opt_update(
+                optimizer, params, grads, opt_state, lr, zero=zero,
+                with_metrics=True)
+        else:
+            new_params, new_state, om = guarded_opt_update(
+                params, grads, opt_state, loss, lr, optimizer, guard,
+                zero=zero, with_metrics=True)
+        # optimizer block LAST: its true ‖Δp‖/‖p‖ update_ratio overrides
+        # the lr·‖g‖ SGD proxy train_step_metrics emits
+        metrics = {**metrics,
+                   **train_step_metrics(params, grads, lr, loss=loss),
+                   **om}
+        return new_params, new_state, loss, metrics
+
+    return _seam(step)
+
+
 def _make_sgd_step(loss_fn, lr: float, with_metrics: bool,
                    donate: bool = False, guard=None, profile=None,
                    profile_label: str = "lm_step"):
@@ -456,7 +566,7 @@ def make_composed_train_step(mesh: Mesh, n_heads: int, capacity: int,
                              moe_impl: Optional[str] = None,
                              with_metrics: bool = False,
                              donate: bool = False, guard=None,
-                             profile=None):
+                             profile=None, optimizer=None):
     """SGD step over the composed mesh: step(params, tokens, targets) ->
     (new_params, loss). Shard inputs with shard_lm_params/shard_lm_batch
     first; GSPMD + the shard_map transposes insert every collective
@@ -480,13 +590,35 @@ def make_composed_train_step(mesh: Mesh, n_heads: int, capacity: int,
     the HLO collective inventory, which on this mesh shows the grad
     all-reduces, the ring collective-permutes (when "sp" is present), and
     the MoE all_to_all exchange (when the alltoall dispatch resolves);
-    see telemetry/xprofile.py."""
+    see telemetry/xprofile.py.
+
+    ``optimizer=`` (ISSUE 13; a name string — "adam" | "lamb" | "adagrad"
+    | "momentum" — or an ``optimize.updaters.OptimizerConfig``) swaps the
+    SGD update for the in-graph stateful updater: the step becomes
+    ``step(params, opt_state, tokens, targets) -> (new_params,
+    new_opt_state, loss[, ...])`` with ``opt_state`` from
+    ``init_lm_opt_state``. Moments are sharded like their params
+    (expert-sharded MoE leaves); ``update_sharding="sharded"`` (explicit
+    > ``DL4J_TPU_UPDATE_SHARDING`` env > replicated) additionally runs
+    the ZeRO-style dp-sharded update — each replica updates 1/dp of the
+    replicated leaves and the params allgather back, parity ≤1e-6 vs
+    replicated pinned in tests/test_updaters.py. Moments donate, thread
+    through the ``guard=`` skip-select bitwise, and checkpoint through
+    ``updaters.canonical_opt_state``."""
     from deeplearning4j_tpu.optimize.guardrails import GuardConfig
+    from deeplearning4j_tpu.optimize.updaters import OptimizerConfig
 
     loss_fn = composed_loss_fn(mesh, n_heads, capacity, top_k, aux_weight,
                                attn_impl=attn_impl, moe_impl=moe_impl,
                                with_metrics=with_metrics)
     label = "lm_composed[" + "x".join(mesh.axis_names) + "]"
+    opt_cfg = OptimizerConfig.coerce(optimizer)
+    if opt_cfg is not None:
+        zero = lm_update_sharding(mesh) if opt_cfg.sharded else None
+        return _make_opt_step(loss_fn, lr, with_metrics,
+                              opt_cfg.resolved(), zero, donate=donate,
+                              guard=GuardConfig.coerce(guard),
+                              profile=profile, profile_label=label)
     return _make_sgd_step(loss_fn, lr, with_metrics, donate=donate,
                           guard=GuardConfig.coerce(guard), profile=profile,
                           profile_label=label)
@@ -497,17 +629,34 @@ def make_single_device_train_step(n_heads: int, lr: float = 0.1,
                                   attn_impl: Optional[str] = None,
                                   with_metrics: bool = False,
                                   donate: bool = False, guard=None,
-                                  profile=None):
+                                  profile=None, optimizer=None):
     """The dense twin of make_composed_train_step (parity oracle when
     called with ``attn_impl="dense"``; the flagship single-chip bench path
     with the default auto core). ``with_metrics``/``donate``/``guard``/
-    ``profile`` as on the composed builder (bench hot loops pass
-    donate=True; the guardrails bench stage passes guard=True on top; the
-    profile stage passes profile=True)."""
+    ``profile``/``optimizer`` as on the composed builder (bench hot loops
+    pass donate=True; the guardrails bench stage passes guard=True on
+    top; the profile stage passes profile=True). With ``optimizer=`` the
+    step carries the opt state (``init_lm_opt_state(optimizer, params)``)
+    as a second argument/output; there is no dp axis here, so
+    ``update_sharding="sharded"`` is rejected rather than silently
+    running the replicated update under a ZeRO label."""
     from deeplearning4j_tpu.optimize.guardrails import GuardConfig
+    from deeplearning4j_tpu.optimize.updaters import OptimizerConfig
 
     loss_fn = dense_loss_fn(n_heads, top_k, aux_weight, attn_impl=attn_impl,
                             with_metrics=with_metrics)
+    opt_cfg = OptimizerConfig.coerce(optimizer)
+    if opt_cfg is not None:
+        if opt_cfg.sharded:
+            raise ValueError(
+                "update_sharding='sharded' needs a dp mesh axis — the "
+                "single-device step has no replicas to shard the update "
+                "over (use make_composed_train_step)")
+        return _make_opt_step(loss_fn, lr, with_metrics,
+                              opt_cfg.resolved(), None, donate=donate,
+                              guard=GuardConfig.coerce(guard),
+                              profile=profile,
+                              profile_label="lm_single_device")
     return _make_sgd_step(loss_fn, lr, with_metrics, donate=donate,
                           guard=GuardConfig.coerce(guard), profile=profile,
                           profile_label="lm_single_device")

@@ -1,0 +1,555 @@
+"""In-graph optimizers with ZeRO-style cross-replica sharded update state.
+
+ISSUE 13 (ROADMAP 1): every composed train step was plain SGD with zero
+optimizer state — this module adds Adam and LAMB (plus the reference's
+AdaGrad/momentum lineage, see ``updater.py``) as pure ``init/update``
+pytree transforms behind an ``optimizer=`` seam mirroring the
+``attn_impl``/``guard``/``profile`` seams on every composed step factory:
+``models/transformer_lm.make_single_device_train_step`` /
+``make_composed_train_step`` (dp×ep, dp×sp×ep),
+``parallel/pipeline.make_pipeline_train_step`` (dp×pp),
+``parallel/trainer.make_sync_train_step``, and the elastic
+``SyntheticRegressionModel(optimizer=...)``.
+
+Moments are sharded **the same way as their params** — expert-sharded for
+MoE leaves, stage-sharded for pp — and the dp axis gets a ZeRO-style mode
+per "Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+Training" (arXiv:2004.13336): with ``update_sharding="sharded"`` each
+replica stores and updates only its 1/dp slice of the (dp-replicated)
+leaves and the updated params are allgathered, instead of every replica
+redundantly running the full update on a full copy of the moments. The
+two modes are THE SAME MATH — Adam's update is elementwise, so
+sharded-vs-replicated parity is pinned ≤1e-6 (bit-exact for Adam) in
+tests/test_updaters.py, with the xprofile collective inventory asserting
+the expected all-gather appears and the per-replica update FLOPs drop.
+
+Layout: a dp-sharded moment leaf for a param of shape ``S`` with kept
+prefix dims ``S[:k]`` (the already-sharded expert/stage axes) is stored as
+``S[:k] + (dp, ceil(prod(S[k:]) / dp))`` — trailing dims flattened, padded
+to a dp multiple, the new axis sharded over the dp mesh axis. The padded
+tail is zeros and every padded lane computes an exactly-zero update, so
+the layout is invisible to the math. ``canonical_opt_state`` /
+``partition_opt_state`` convert to/from the param-shaped canonical layout
+at the checkpoint boundary (the same discipline as
+``pp_trained_to_lm_params``), so an optimizer checkpoint restores onto
+ANY mesh through the ordinary resharding loader.
+
+Guard integration: a non-finite step must carry the moments bitwise, like
+params — ``guarded_opt_update`` runs the guardrails finiteness test /
+optional clip and selects params AND the full optimizer state (moments +
+step count) against the incoming trees (pinned in tests/test_updaters.py).
+
+Seam precedence for the update-sharding mode: explicit
+``OptimizerConfig(update_sharding=...)`` > the ``DL4J_TPU_UPDATE_SHARDING``
+env knob > ``"replicated"`` — resolved host-side at build time, never
+inside a traced body (the graftlint-blessed ``DL4J_TPU_*`` namespace).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, replace
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Array = jax.Array
+
+UPDATE_SHARDING_ENV = "DL4J_TPU_UPDATE_SHARDING"
+_MODES = ("replicated", "sharded")
+
+_NAMES = ("sgd", "adam", "lamb", "adagrad", "momentum")
+# legacy GradientAdjustment lineage (updater.py) uses 1e-6 — the adagrad
+# bridge must match it exactly for the cross-stack parity pin
+_ADAGRAD_EPS = 1e-6
+
+
+def resolve_update_sharding(explicit: Optional[str] = None) -> str:
+    """``explicit`` > ``DL4J_TPU_UPDATE_SHARDING`` env > ``"replicated"``.
+    Host-side, resolved once at step-build time."""
+    for source, val in (("update_sharding=", explicit),
+                        (UPDATE_SHARDING_ENV,
+                         os.environ.get(UPDATE_SHARDING_ENV))):
+        if val:
+            if val not in _MODES:
+                raise ValueError(
+                    f"{source} must be one of {_MODES}, got {val!r}")
+            return val
+    return "replicated"
+
+
+@dataclass(frozen=True)
+class OptimizerConfig:
+    """Static (trace-time) optimizer policy for one train step.
+
+    ``name``: ``adam`` | ``lamb`` | ``adagrad`` | ``momentum`` | ``sgd``.
+    ``lr=None`` inherits the step builder's ``lr``. ``weight_decay`` is
+    decoupled (AdamW-style; folded into the LAMB trust-ratio numerator as
+    the LAMB paper specifies). ``update_sharding=None`` resolves through
+    the env chain (see ``resolve_update_sharding``). All fields are Python
+    statics — changing them builds a new step, exactly like ``guard=``.
+    """
+
+    name: str = "adam"
+    lr: Optional[float] = None
+    b1: float = 0.9
+    b2: float = 0.999
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    momentum: float = 0.9
+    update_sharding: Optional[str] = None
+
+    def __post_init__(self):
+        if self.name not in _NAMES:
+            raise ValueError(
+                f"optimizer name must be one of {_NAMES}, got {self.name!r}")
+
+    @classmethod
+    def coerce(cls, optimizer) -> Optional["OptimizerConfig"]:
+        """Normalize the seam argument: None/False → no optimizer (the
+        step keeps its plain-SGD shape and signature), a name string →
+        that optimizer's defaults, an OptimizerConfig → itself."""
+        if optimizer is None or optimizer is False:
+            return None
+        if isinstance(optimizer, cls):
+            return optimizer
+        if isinstance(optimizer, str):
+            if optimizer == "adagrad":
+                # match the legacy GradientAdjustment epsilon so the two
+                # update stacks cannot silently diverge (parity pinned in
+                # tests/test_updaters.py)
+                return cls(name="adagrad", eps=_ADAGRAD_EPS)
+            return cls(name=optimizer)
+        raise TypeError(
+            "optimizer= must be None/False, a name string "
+            f"({'|'.join(_NAMES)}), or an OptimizerConfig; got "
+            f"{type(optimizer).__name__}")
+
+    def resolved(self) -> "OptimizerConfig":
+        """The config with ``update_sharding`` pinned through the env
+        chain — call once at build time so the traced step is a pure
+        function of the config object."""
+        return replace(self,
+                       update_sharding=resolve_update_sharding(
+                           self.update_sharding))
+
+    @property
+    def sharded(self) -> bool:
+        return resolve_update_sharding(self.update_sharding) == "sharded"
+
+
+# ------------------------------------------------------------ ZeRO layout ----
+
+class ZeroSharding:
+    """Where the dp-sharded update lives: the mesh, the dp axis, and a
+    per-leaf ``prefix_fn(keystr) -> tuple`` naming the mesh axes of the
+    KEPT leading dims (the already-sharded expert/stage axes — e.g.
+    ``(None, "expert")`` for the flagship's (L, E, ...) expert leaves, or
+    ``("pipe",)`` for stage-stacked pipeline leaves). Trailing dims are
+    flattened, padded to a dp multiple, and sharded over ``axis``."""
+
+    def __init__(self, mesh: Mesh, axis: str = "data",
+                 prefix_fn: Optional[Callable[[str], tuple]] = None):
+        if axis not in mesh.axis_names:
+            raise ValueError(
+                f"update-sharding axis {axis!r} is not on the mesh "
+                f"{mesh.axis_names} — ZeRO mode needs the dp axis")
+        self.mesh = mesh
+        self.axis = axis
+        self.n = int(mesh.shape[axis])
+        self.prefix_fn = prefix_fn or (lambda _ks: ())
+
+    def layout(self, keystr: str, shape: Tuple[int, ...]):
+        """(keep, prefix, chunk, pad) for one leaf."""
+        prefix = tuple(self.prefix_fn(keystr))
+        keep = len(prefix)
+        if keep >= len(shape) and not (keep == 0 and shape == ()):
+            raise ValueError(
+                f"ZeRO prefix {prefix} keeps every dim of leaf {keystr} "
+                f"{shape} — nothing left to shard over {self.axis!r}")
+        rest = 1
+        for d in shape[keep:]:
+            rest *= int(d)
+        chunk = -(-rest // self.n)
+        return keep, prefix, chunk, self.n * chunk - rest
+
+    def sharded_spec(self, prefix: tuple) -> P:
+        return P(*prefix, self.axis)
+
+    def natural_spec(self, prefix: tuple) -> P:
+        return P(*prefix)
+
+
+def _partition(x, keep: int, n: int, chunk: int, pad: int):
+    """param-shaped → ``lead + (n, chunk)`` (flatten trailing dims, pad
+    with zeros to an ``n`` multiple, fold the shard axis out). Pure
+    reshape/pad — works on host numpy and inside jit alike."""
+    lead = tuple(x.shape[:keep])
+    mod = np if isinstance(x, np.ndarray) else jnp
+    flat = x.reshape(lead + (-1,))
+    if pad:
+        flat = mod.pad(flat, [(0, 0)] * keep + [(0, pad)])
+    return flat.reshape(lead + (n, chunk))
+
+
+def _unpartition(y, keep: int, shape: Tuple[int, ...]):
+    """Inverse of ``_partition`` (drops the zero padding)."""
+    lead = tuple(y.shape[:keep])
+    rest = 1
+    for d in shape[keep:]:
+        rest *= int(d)
+    flat = y.reshape(lead + (-1,))
+    return flat[..., :rest].reshape(shape)
+
+
+# ------------------------------------------------------------ update math ----
+
+def _leaf_update(cfg: OptimizerConfig, p, g, m, v, t, lr: float, sumsq):
+    """One leaf's update: returns ``(update, new_m, new_v, trust)`` where
+    ``update`` is the fully-scaled quantity to SUBTRACT from the param
+    (lr, bias correction, weight decay, and — for LAMB — the trust ratio
+    already applied) and ``trust`` is the per-leaf LAMB trust ratio (None
+    for the other names). Elementwise except the LAMB norms, which go
+    through ``sumsq(x) -> Σx²`` so callers control the cross-shard
+    reduction (plain ``jnp.sum`` under GSPMD, psum-augmented inside
+    shard_map)."""
+    lr_eff = jnp.float32(cfg.lr if cfg.lr is not None else lr)
+    wd = cfg.weight_decay
+    if cfg.name in ("adam", "lamb"):
+        b1, b2 = jnp.float32(cfg.b1), jnp.float32(cfg.b2)
+        new_m = b1 * m + (1.0 - b1) * g
+        new_v = b2 * v + (1.0 - b2) * jnp.square(g)
+        tf = t.astype(jnp.float32)
+        mhat = new_m / (1.0 - jnp.power(b1, tf))
+        vhat = new_v / (1.0 - jnp.power(b2, tf))
+        r = mhat / (jnp.sqrt(vhat) + jnp.float32(cfg.eps))
+        if wd:
+            r = r + jnp.float32(wd) * p
+        if cfg.name == "lamb":
+            pn = jnp.sqrt(sumsq(p))
+            rn = jnp.sqrt(sumsq(r))
+            trust = jnp.where((pn > 0.0) & (rn > 0.0), pn / rn,
+                              jnp.float32(1.0))
+            return lr_eff * trust * r, new_m, new_v, trust
+        return lr_eff * r, new_m, new_v, None
+    if cfg.name == "adagrad":
+        new_v = v + jnp.square(g)
+        upd = lr_eff * g / (jnp.sqrt(new_v) + jnp.float32(cfg.eps))
+        if wd:
+            upd = upd + lr_eff * jnp.float32(wd) * p
+        return upd, m, new_v, None
+    if cfg.name == "momentum":
+        # legacy heavy-ball order (updater.py): lr scales the gradient
+        # BEFORE it enters the velocity — parity with the reference facade
+        new_m = jnp.float32(cfg.momentum) * m + lr_eff * g
+        upd = new_m
+        if wd:
+            upd = upd + lr_eff * jnp.float32(wd) * p
+        return upd, new_m, v, None
+    # sgd through the seam: stateless, for like-for-like A/Bs
+    upd = lr_eff * g
+    if wd:
+        upd = upd + lr_eff * jnp.float32(wd) * p
+    return upd, m, v, None
+
+
+def _flatten_with(params, *others):
+    p_leaves, treedef = jax.tree_util.tree_flatten_with_path(params)
+    keys = [jax.tree_util.keystr(path) for path, _ in p_leaves]
+    flats = [treedef.flatten_up_to(o) for o in others]
+    return keys, [leaf for _, leaf in p_leaves], flats, treedef
+
+
+def opt_update(cfg: OptimizerConfig, params, grads, opt_state, lr: float,
+               zero: Optional[ZeroSharding] = None,
+               with_metrics: bool = False):
+    """The in-graph optimizer transform (GSPMD flavor — jit bodies on any
+    mesh, including none): ``(new_params, new_opt_state[, opt_metrics])``.
+
+    ``opt_state`` is ``{"m": tree, "v": tree, "count": i32 scalar}`` from
+    :func:`init_opt_state` — ``m``/``v`` mirror the params (same sharding)
+    in replicated mode, or live in the ZeRO layout (``zero`` must match
+    the one used at init) in sharded mode, where each leaf is constrained
+    to its dp shard for the update and only the updated PARAMS are
+    allgathered back (``with_sharding_constraint`` → GSPMD inserts the
+    dynamic-slice in and the all-gather out; the moments never
+    re-replicate).
+
+    ``with_metrics`` appends the optimizer-health block: moment global
+    norms, the true ‖Δp‖/‖p‖ update ratio (the lr·‖g‖ proxy is wrong for
+    adaptive updates), and — for LAMB — the mean effective trust ratio.
+    """
+    keys, p_leaves, (g_leaves, m_leaves, v_leaves), treedef = _flatten_with(
+        params, grads, opt_state["m"], opt_state["v"])
+    t = opt_state["count"] + 1
+    wsc = jax.lax.with_sharding_constraint
+    new_p, new_m, new_v = [], [], []
+    upd_sq = p_sq = m_sq = v_sq = jnp.float32(0.0)
+    trusts = []
+    for ks, p, g, m, v in zip(keys, p_leaves, g_leaves, m_leaves, v_leaves):
+        if zero is None:
+            upd, m2, v2, trust = _leaf_update(
+                cfg, p, g, m, v, t, lr, lambda x: jnp.sum(jnp.square(x)))
+            p2 = p - upd
+        else:
+            keep, prefix, chunk, pad = zero.layout(ks, tuple(p.shape))
+            sh = NamedSharding(zero.mesh, zero.sharded_spec(prefix))
+            nat = NamedSharding(zero.mesh, zero.natural_spec(prefix))
+            pp = wsc(_partition(p, keep, zero.n, chunk, pad), sh)
+            gp = wsc(_partition(g, keep, zero.n, chunk, pad), sh)
+            upd, m2, v2, trust = _leaf_update(
+                cfg, pp, gp, m, v, t, lr, lambda x: jnp.sum(jnp.square(x)))
+            m2, v2 = wsc(m2, sh), wsc(v2, sh)
+            p2 = wsc(_unpartition(pp - upd, keep, tuple(p.shape)), nat)
+        new_p.append(p2)
+        new_m.append(m2)
+        new_v.append(v2)
+        if with_metrics:
+            upd_sq = upd_sq + jnp.sum(jnp.square(upd.astype(jnp.float32)))
+            p_sq = p_sq + jnp.sum(jnp.square(p.astype(jnp.float32)))
+            m_sq = m_sq + jnp.sum(jnp.square(m2.astype(jnp.float32)))
+            v_sq = v_sq + jnp.sum(jnp.square(v2.astype(jnp.float32)))
+            if trust is not None:
+                trusts.append(trust)
+    unflatten = jax.tree_util.tree_unflatten
+    new_params = unflatten(treedef, new_p)
+    new_state = {"m": unflatten(treedef, new_m),
+                 "v": unflatten(treedef, new_v), "count": t}
+    if not with_metrics:
+        return new_params, new_state
+    metrics = {
+        "moment_norm_m": jnp.sqrt(m_sq),
+        "moment_norm_v": jnp.sqrt(v_sq),
+        "update_ratio": jnp.sqrt(upd_sq) / (jnp.sqrt(p_sq) + 1e-12),
+    }
+    if trusts:
+        metrics["lamb_trust_ratio"] = jnp.mean(jnp.stack(trusts))
+    return new_params, new_state, metrics
+
+
+def opt_update_shardmap(cfg: OptimizerConfig, params, grads, opt_state,
+                        lr: float, axis: str, n_shards: int,
+                        with_metrics: bool = False):
+    """The shard_map flavor (``parallel/trainer.make_sync_train_step``):
+    runs INSIDE the mapped body where collectives are explicit. Replicated
+    mode is :func:`opt_update` verbatim; sharded mode slices each
+    device's chunk by ``lax.axis_index(axis)``, updates it, and
+    ``all_gather``s only the params — the moment rows stay per-device
+    (their global (n, chunk) leaves ride the shard_map specs with the
+    leading shard axis on ``axis``, so each body sees a (1, chunk) row).
+    ``n_shards`` is the static dp size (shapes can't depend on a traced
+    ``psum``)."""
+    if not cfg.sharded:
+        return opt_update(cfg, params, grads, opt_state, lr, zero=None,
+                          with_metrics=with_metrics)
+    keys, p_leaves, (g_leaves, m_leaves, v_leaves), treedef = _flatten_with(
+        params, grads, opt_state["m"], opt_state["v"])
+    t = opt_state["count"] + 1
+    my = jax.lax.axis_index(axis)
+
+    def sumsq(x):
+        return jax.lax.psum(jnp.sum(jnp.square(x)), axis)
+
+    new_p, new_m, new_v = [], [], []
+    upd_sq = p_sq = m_sq = v_sq = jnp.float32(0.0)
+    trusts = []
+    for ks, p, g, m, v in zip(keys, p_leaves, g_leaves, m_leaves, v_leaves):
+        shape = tuple(p.shape)
+        rest = 1
+        for d in shape:
+            rest *= int(d)
+        chunk = -(-rest // n_shards)
+        pad = n_shards * chunk - rest
+        pp = _partition(p, 0, n_shards, chunk, pad)
+        gp = _partition(g, 0, n_shards, chunk, pad)
+        p_row = jax.lax.dynamic_index_in_dim(pp, my, 0, keepdims=True)
+        g_row = jax.lax.dynamic_index_in_dim(gp, my, 0, keepdims=True)
+        upd, m2, v2, trust = _leaf_update(cfg, p_row, g_row, m, v, t, lr,
+                                          sumsq)
+        rows = jax.lax.all_gather(p_row - upd, axis, axis=0, tiled=True)
+        new_p.append(_unpartition(rows, 0, shape))
+        new_m.append(m2)
+        new_v.append(v2)
+        if with_metrics:
+            upd_sq = upd_sq + sumsq(upd.astype(jnp.float32))
+            p_sq = p_sq + jnp.sum(jnp.square(p.astype(jnp.float32)))
+            m_sq = m_sq + sumsq(m2.astype(jnp.float32))
+            v_sq = v_sq + sumsq(v2.astype(jnp.float32))
+            if trust is not None:
+                trusts.append(trust)
+    unflatten = jax.tree_util.tree_unflatten
+    new_params = unflatten(treedef, new_p)
+    new_state = {"m": unflatten(treedef, new_m),
+                 "v": unflatten(treedef, new_v), "count": t}
+    if not with_metrics:
+        return new_params, new_state
+    metrics = {
+        "moment_norm_m": jnp.sqrt(m_sq),
+        "moment_norm_v": jnp.sqrt(v_sq),
+        "update_ratio": jnp.sqrt(upd_sq) / (jnp.sqrt(p_sq) + 1e-12),
+    }
+    if trusts:
+        metrics["lamb_trust_ratio"] = jnp.mean(jnp.stack(trusts))
+    return new_params, new_state, metrics
+
+
+def guarded_opt_update(params, grads, opt_state, loss, lr: float,
+                       cfg: OptimizerConfig, guard,
+                       zero: Optional[ZeroSharding] = None,
+                       with_metrics: bool = False):
+    """The optimizer update with the ISSUE 8 guardrails fused in:
+    finiteness of loss + grad global-norm, optional global-norm clip, and
+    the skip-on-nonfinite select over params AND the FULL optimizer state
+    (a NaN step must leave moments + step count bitwise untouched, or a
+    poisoned batch would still corrupt the Adam trajectory). Returns
+    ``(new_params, new_opt_state, metrics)`` where metrics is the guard
+    block (plus the optimizer block when ``with_metrics``)."""
+    from deeplearning4j_tpu.optimize.guardrails import (
+        clip_by_global_norm,
+        guard_select,
+        guard_stats,
+    )
+
+    gn, finite = guard_stats(loss, grads)
+    clipped = jnp.float32(0.0)
+    if guard.clip_norm is not None:
+        grads, was_clipped = clip_by_global_norm(grads, gn, guard.clip_norm)
+        clipped = jnp.logical_and(was_clipped, finite).astype(jnp.float32)
+    out = opt_update(cfg, params, grads, opt_state, lr, zero=zero,
+                     with_metrics=with_metrics)
+    new_params, new_state = out[0], out[1]
+    opt_metrics = out[2] if with_metrics else {}
+    if guard.skip_nonfinite:
+        new_params = guard_select(finite, new_params, params)
+        new_state = guard_select(finite, new_state, opt_state)
+    metrics = {
+        **opt_metrics,
+        "nonfinite": jnp.logical_not(finite).astype(jnp.float32),
+        "clipped": clipped,
+        "guard_grad_norm": gn,
+    }
+    return new_params, new_state, metrics
+
+
+# ------------------------------------------------- state init / placement ----
+
+def _zeros_like_placed(leaf):
+    """Zeros with the leaf's shape/dtype AND sharding — moments must live
+    exactly where their params do (expert-sharded for MoE leaves,
+    stage-sharded for pp). Only mesh (Named) shardings are mirrored:
+    re-placing with a SingleDeviceSharding would COMMIT the moments to
+    one device and break steps whose params are uncommitted."""
+    z = jnp.zeros(np.shape(leaf), getattr(leaf, "dtype", jnp.float32))
+    sharding = getattr(leaf, "sharding", None)
+    if isinstance(sharding, NamedSharding):
+        return jax.device_put(z, sharding)
+    return z
+
+
+def init_opt_state(cfg: Optional[OptimizerConfig], params,
+                   zero: Optional[ZeroSharding] = None):
+    """Host-side state constructor: ``{"m", "v", "count"}`` with every
+    moment leaf placed like its param (replicated mode) or in the
+    dp-sharded ZeRO layout (sharded mode — per-replica moment bytes are
+    ~1/dp of the replicated mode's, the at-rest half of the 2004.13336
+    win). Stateless names still get zero moments so the step signature,
+    donation, guard select, and checkpoints are shape-uniform."""
+    if cfg is None:
+        raise ValueError("init_opt_state needs an OptimizerConfig "
+                         "(use OptimizerConfig.coerce first)")
+    if zero is None:
+        m = jax.tree_util.tree_map(_zeros_like_placed, params)
+        v = jax.tree_util.tree_map(_zeros_like_placed, params)
+        count = jnp.zeros((), jnp.int32)
+        return {"m": m, "v": v, "count": count}
+
+    def one(path, leaf):
+        ks = jax.tree_util.keystr(path)
+        keep, prefix, chunk, pad = zero.layout(ks, tuple(np.shape(leaf)))
+        shape = tuple(np.shape(leaf)[:keep]) + (zero.n, chunk)
+        sh = NamedSharding(zero.mesh, zero.sharded_spec(prefix))
+        return jax.device_put(
+            np.zeros(shape, getattr(leaf, "dtype", np.float32)), sh)
+
+    m = jax.tree_util.tree_map_with_path(one, params)
+    v = jax.tree_util.tree_map_with_path(one, params)
+    count = jax.device_put(np.zeros((), np.int32),
+                           NamedSharding(zero.mesh, P()))
+    return {"m": m, "v": v, "count": count}
+
+
+def canonical_opt_state(opt_state, params_like,
+                        zero: Optional[ZeroSharding] = None):
+    """The checkpoint boundary (mirrors ``pp_trained_to_lm_params``):
+    gather the moments back to the PARAM-SHAPED canonical layout — host
+    numpy trees, mesh-independent, so ``{"opt": canonical}`` saves restore
+    onto any mesh through the ordinary resharding loader. Replicated-mode
+    states (already param-shaped) pass through as host arrays."""
+    if zero is None:
+        return {
+            "m": jax.tree_util.tree_map(np.asarray,
+                                        jax.device_get(opt_state["m"])),
+            "v": jax.tree_util.tree_map(np.asarray,
+                                        jax.device_get(opt_state["v"])),
+            "count": np.asarray(jax.device_get(opt_state["count"])),
+        }
+
+    def gather(tree):
+        def one(path, leaf):
+            ks = jax.tree_util.keystr(path)
+            p_leaf = leaf_of(params_like, path)
+            shape = tuple(np.shape(p_leaf))
+            keep, _prefix, _chunk, _pad = zero.layout(ks, shape)
+            return _unpartition(np.asarray(jax.device_get(leaf)), keep,
+                                shape)
+
+        return jax.tree_util.tree_map_with_path(one, tree)
+
+    return {"m": gather(opt_state["m"]), "v": gather(opt_state["v"]),
+            "count": np.asarray(jax.device_get(opt_state["count"]))}
+
+
+def leaf_of(tree, path):
+    """Follow a tree_util key path into ``tree`` (dict keys and
+    sequence indices — the layouts the state trees here use)."""
+    node = tree
+    for k in path:
+        if hasattr(k, "key"):
+            node = node[k.key]
+        elif hasattr(k, "idx"):
+            node = node[k.idx]
+        else:
+            raise TypeError(f"unsupported tree path element {k!r}")
+    return node
+
+
+def partition_opt_state(canonical, zero: ZeroSharding):
+    """Inverse of :func:`canonical_opt_state`: place a param-shaped
+    canonical state into the ZeRO layout on ``zero``'s mesh (the resume
+    path of a sharded-update run, after the resharding loader produced
+    the canonical tree)."""
+    def place(tree):
+        def one(path, leaf):
+            ks = jax.tree_util.keystr(path)
+            arr = np.asarray(jax.device_get(leaf))
+            keep, prefix, chunk, pad = zero.layout(ks, tuple(arr.shape))
+            part = _partition(arr, keep, zero.n, chunk, pad)
+            sh = NamedSharding(zero.mesh, zero.sharded_spec(prefix))
+            return jax.device_put(part, sh)
+
+        return jax.tree_util.tree_map_with_path(one, tree)
+
+    count = np.asarray(jax.device_get(canonical["count"]))
+    return {"m": place(canonical["m"]), "v": place(canonical["v"]),
+            "count": jax.device_put(count.astype(np.int32),
+                                    NamedSharding(zero.mesh, P()))}
+
+
+def opt_state_shardings(param_shardings):
+    """Restore-time shardings for a CANONICAL optimizer state: the moment
+    trees reshard exactly like their params (that is the whole placement
+    contract); the step count stays unsharded."""
+    return {"m": param_shardings, "v": param_shardings, "count": None}

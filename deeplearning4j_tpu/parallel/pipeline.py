@@ -268,12 +268,50 @@ def heterogeneous_pipeline_from_conf(conf, params, mesh: Mesh,
     return stacked, stage_fn, confs[-1].n_out
 
 
+def pp_update_sharding(mesh: Mesh, axis: str = PIPE_AXIS,
+                       batch_axis: str = "data"):
+    """ZeRO update-sharding descriptor for stage-stacked pipeline params
+    (optimize/updaters.ZeroSharding): every leaf keeps its leading STAGE
+    axis (sharded over ``axis``) — moments stay stage-sharded exactly
+    like their params — and the flattened per-stage remainder shards over
+    ``batch_axis`` (the dp rows of a dp×pp mesh)."""
+    from deeplearning4j_tpu.optimize.updaters import ZeroSharding
+
+    if batch_axis not in mesh.axis_names:
+        raise ValueError(
+            f"update_sharding='sharded' needs the {batch_axis!r} axis on "
+            f"the mesh (got {mesh.axis_names})")
+    return ZeroSharding(mesh, batch_axis, lambda _ks: (axis,))
+
+
+def init_pp_opt_state(optimizer, stacked, mesh: Mesh,
+                      axis: str = PIPE_AXIS,
+                      batch_axis: "str | None" = None):
+    """Optimizer state for ``make_pipeline_train_step(optimizer=...)``:
+    moments mirror the stacked stage params (stage-sharded — the zeros
+    are placed with each leaf's own sharding), or live in the
+    stage-kept/dp-sharded ZeRO layout when the config resolves
+    ``update_sharding="sharded"``."""
+    from deeplearning4j_tpu.optimize.updaters import (
+        OptimizerConfig,
+        init_opt_state,
+    )
+
+    cfg = OptimizerConfig.coerce(optimizer)
+    if cfg is None:
+        raise ValueError("init_pp_opt_state needs an optimizer")
+    zero = None
+    if cfg.sharded:
+        zero = pp_update_sharding(mesh, axis, batch_axis or "data")
+    return init_opt_state(cfg, stacked, zero)
+
+
 def make_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
                              mesh: Mesh, axis: str = PIPE_AXIS,
                              lr: float = 0.1,
                              batch_axis: "str | None" = None,
                              with_metrics: bool = False, guard=None,
-                             profile=None):
+                             profile=None, optimizer=None):
     """SGD train step over the pipelined stack.
 
     loss = mean over microbatches of ``loss_fn(y, labels_mb)`` on the
@@ -299,11 +337,20 @@ def make_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
     ``StepProfile`` on ``step.step_profile`` (telemetry/xprofile.py) —
     its collective inventory shows the stage-handoff ppermutes as
     collective-permute ops plus the output/grad psums of the schedule.
+
+    ``optimizer=`` (ISSUE 13) swaps the SGD update for the in-graph
+    stateful updater (optimize/updaters.py): ``step(params, opt_state,
+    x_mbs, y_mbs) -> (new_params, new_opt_state, loss[, metrics])`` with
+    ``opt_state`` from ``init_pp_opt_state``. Moments are STAGE-SHARDED
+    like their params; ``update_sharding="sharded"`` additionally shards
+    the per-stage update over ``batch_axis`` (ZeRO over the dp rows of a
+    dp×pp mesh). Moments donate and ride the guard skip-select bitwise.
     """
     from deeplearning4j_tpu.optimize.guardrails import (
         GuardConfig,
         guarded_sgd_update,
     )
+    from deeplearning4j_tpu.optimize.updaters import OptimizerConfig
     from deeplearning4j_tpu.telemetry.xprofile import maybe_profiled
 
     guard = GuardConfig.coerce(guard)
@@ -314,6 +361,47 @@ def make_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
                               batch_axis=batch_axis)
         per = jax.vmap(loss_fn)(outs, y_mbs)
         return jnp.mean(per), per
+
+    opt_cfg = OptimizerConfig.coerce(optimizer)
+    if opt_cfg is not None:
+        from deeplearning4j_tpu.optimize.updaters import (
+            guarded_opt_update,
+            opt_update,
+        )
+
+        opt_cfg = opt_cfg.resolved()
+        zero = (pp_update_sharding(mesh, axis, batch_axis or "data")
+                if opt_cfg.sharded else None)
+
+        from deeplearning4j_tpu.telemetry.metrics import train_step_metrics
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def opt_step(params, opt_state, x_mbs, y_mbs):
+            (loss, per), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params, x_mbs, y_mbs)
+            if guard is None:
+                out = opt_update(opt_cfg, params, grads, opt_state, lr,
+                                 zero=zero, with_metrics=with_metrics)
+                new_params, new_state = out[0], out[1]
+                gm = out[2] if with_metrics else {}
+            else:
+                new_params, new_state, gm = guarded_opt_update(
+                    params, grads, opt_state, loss, lr, opt_cfg, guard,
+                    zero=zero, with_metrics=with_metrics)
+            if not with_metrics and guard is None:
+                return new_params, new_state, loss
+            metrics = dict(gm)
+            if with_metrics:
+                base = train_step_metrics(params, grads, lr, loss=loss)
+                base.pop("update_ratio", None)  # gm carries the true one
+                metrics.update({
+                    "microbatch_loss": per.reshape(per.shape[0],
+                                                   -1).mean(axis=1),
+                    **base,
+                })
+            return new_params, new_state, loss, metrics
+
+        return maybe_profiled(opt_step, profile, label)
 
     if not with_metrics and guard is None:
         @partial(jax.jit, donate_argnums=(0,))

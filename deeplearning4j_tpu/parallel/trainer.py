@@ -47,7 +47,8 @@ Array = jax.Array
 
 def _local_grad_step(conf, params, states, iteration, x, y, w, key,
                      sync_grads: bool, ablate_collectives: bool = False,
-                     with_metrics: bool = False, guard=None):
+                     with_metrics: bool = False, guard=None,
+                     optimizer=None, opt_n_shards: int = 1):
     """One update step over a weighted batch shard.
 
     ``w`` is a per-row weight (0 for padded rows). The loss is the weighted
@@ -128,6 +129,40 @@ def _local_grad_step(conf, params, states, iteration, x, y, w, key,
             "clipped": clipped,
             "guard_grad_norm": gn,
         }
+    if optimizer is not None:
+        # ISSUE 13: the in-graph stateful updater replaces the per-layer
+        # legacy apply_updater loop — `states` here is the
+        # {"m","v","count"} optimizer state (init_sync_opt_state), and
+        # in ZeRO mode each replica updates only its 1/dp chunk and
+        # all_gathers the params (optimize/updaters.opt_update_shardmap;
+        # guard clip above already rescaled the grads the updater sees)
+        from deeplearning4j_tpu.optimize.updaters import opt_update_shardmap
+
+        lr0 = conf.conf(0).lr  # python float (static conf), not traced
+        out = opt_update_shardmap(optimizer, params, grads, states, lr0,
+                                  DATA_AXIS, opt_n_shards,
+                                  with_metrics=with_metrics)
+        new_params, new_states = out[0], out[1]
+        opt_metrics = out[2] if with_metrics else {}
+        if guard is not None and guard.skip_nonfinite:
+            from deeplearning4j_tpu.optimize.guardrails import guard_select
+
+            new_params = guard_select(guard_finite, new_params, params)
+            new_states = guard_select(guard_finite, new_states, states)
+        if not with_metrics and guard is not None:
+            return new_params, new_states, score, guard_metrics
+        if not with_metrics:
+            return new_params, new_states, score
+        from deeplearning4j_tpu.telemetry.metrics import global_norm
+
+        metrics = {
+            "loss": jnp.asarray(score, jnp.float32),
+            "grad_norm": global_norm(grads),
+            "param_norm": global_norm(params),
+            **opt_metrics,
+            **guard_metrics,
+        }
+        return new_params, new_states, score, metrics
     new_params = []
     new_states = []
     updates = []
@@ -168,10 +203,29 @@ def _local_grad_step(conf, params, states, iteration, x, y, w, key,
     return tuple(new_params), tuple(new_states), score, metrics
 
 
+def init_sync_opt_state(optimizer, params, mesh: Mesh):
+    """Optimizer state for ``make_sync_train_step(optimizer=...)``:
+    param-mirroring zero moments (replicated mode — the DP trainer keeps
+    params replicated, so moments are too), or the flattened (dp, chunk)
+    ZeRO layout sharded over the "data" axis (sharded mode: each replica
+    stores 1/dp of every moment leaf)."""
+    from deeplearning4j_tpu.optimize.updaters import (
+        OptimizerConfig,
+        ZeroSharding,
+        init_opt_state,
+    )
+
+    cfg = OptimizerConfig.coerce(optimizer)
+    if cfg is None:
+        raise ValueError("init_sync_opt_state needs an optimizer")
+    zero = ZeroSharding(mesh, DATA_AXIS) if cfg.sharded else None
+    return init_opt_state(cfg, params, zero)
+
+
 def make_sync_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
                          ablate_collectives: bool = False,
                          with_metrics: bool = False, guard=None,
-                         profile=None):
+                         profile=None, optimizer=None):
     """Per-step averaging: grads AllReduced every iteration.
 
     step(params, states, iteration, x, y, w, key) — ``w`` is the per-row
@@ -199,23 +253,49 @@ def make_sync_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
     ``StepProfile`` on ``step.step_profile`` (telemetry/xprofile.py) —
     its collective inventory pins the ONE fused gradient all-reduce this
     step is supposed to issue (the scaling_bench invariant).
+
+    ``optimizer=`` (ISSUE 13; name string or
+    ``optimize.updaters.OptimizerConfig``) replaces the legacy per-layer
+    ``apply_updater`` with the in-graph stateful updater — ``states``
+    then carries the ``{"m","v","count"}`` optimizer state from
+    ``init_sync_opt_state`` instead of the AdaGrad/momentum tree.
+    ``update_sharding="sharded"`` runs the ZeRO-style update INSIDE the
+    shard_map body: each replica slices its 1/dp chunk of the (psum'd)
+    grads and moments, updates it, and ``all_gather``s only the params —
+    parity ≤1e-6 vs the replicated mode pinned in
+    tests/test_updaters.py. Moments stay donated and ride the guard
+    skip-select bitwise.
     """
     from deeplearning4j_tpu.optimize.guardrails import GuardConfig
+    from deeplearning4j_tpu.optimize.updaters import OptimizerConfig
     from deeplearning4j_tpu.telemetry.xprofile import maybe_profiled
 
     guard = GuardConfig.coerce(guard)
+    opt_cfg = OptimizerConfig.coerce(optimizer)
+    if opt_cfg is not None:
+        opt_cfg = opt_cfg.resolved()
+    n_dp = int(mesh.shape[DATA_AXIS])
 
     def step(params, states, iteration, x, y, w, key):
         return _local_grad_step(conf, params, states, iteration, x, y, w, key,
                                 True, ablate_collectives,
-                                with_metrics=with_metrics, guard=guard)
+                                with_metrics=with_metrics, guard=guard,
+                                optimizer=opt_cfg, opt_n_shards=n_dp)
 
-    out_specs = ((P(), P(), P(), P()) if (with_metrics or guard is not None)
-                 else (P(), P(), P()))
+    if opt_cfg is not None and opt_cfg.sharded:
+        # ZeRO layout: the (dp, chunk) moment leaves shard their leading
+        # dim over the dp axis; the step count stays replicated
+        state_spec = {"m": P(DATA_AXIS), "v": P(DATA_AXIS), "count": P()}
+    else:
+        state_spec = P()
+    out_specs = ((P(), state_spec, P(), P())
+                 if (with_metrics or guard is not None)
+                 else (P(), state_spec, P()))
     sharded = shard_map(
         step,
         mesh=mesh,
-        in_specs=(P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
+        in_specs=(P(), state_spec, P(), P(DATA_AXIS), P(DATA_AXIS),
+                  P(DATA_AXIS), P()),
         out_specs=out_specs,
         check_vma=False,
     )

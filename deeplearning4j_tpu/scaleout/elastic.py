@@ -195,14 +195,22 @@ class SyntheticRegressionModel(ElasticModel):
     ``telemetry.xprofile.ProfiledStep`` — after the first ``run_steps``
     the compile-time :class:`StepProfile` (cost/memory analysis + the
     grad all-reduce inventory of the data-parallel mesh) is exposed as
-    ``model.step_profile``."""
+    ``model.step_profile``.
+
+    Optimizer (ISSUE 13): ``optimizer=`` ("adam" | "lamb" | ... or an
+    ``optimize.updaters.OptimizerConfig``) swaps SGD for the in-graph
+    stateful updater; moments persist on the instance across
+    ``run_steps`` calls (local optimizer state under parameter
+    averaging) and — with ``update_sharding="sharded"`` — live
+    dp-partitioned over the model's own data mesh, composing with
+    ``guard=True`` (a skipped step carries the moments bitwise)."""
 
     def __init__(self, d_in: int = 8, d_hidden: int = 16, batch: int = 32,
                  lr: float = 0.05, seed: int = 0, mesh_devices: int = 2,
                  guard: bool = False, clip_norm: Optional[float] = None,
                  nan_at_step: Optional[int] = None,
                  nan_worker_seed: Optional[int] = None,
-                 profile: bool = False):
+                 profile: bool = False, optimizer=None):
         self.d_in, self.d_hidden = int(d_in), int(d_hidden)
         self.batch, self.lr, self.seed = int(batch), float(lr), int(seed)
         self.mesh_devices = int(mesh_devices)
@@ -211,9 +219,18 @@ class SyntheticRegressionModel(ElasticModel):
         self.nan_at_step = nan_at_step
         self.nan_worker_seed = nan_worker_seed
         self.profile = profile
+        # ISSUE 13: the optimizer= seam (name string or OptimizerConfig).
+        # Moments live on the model instance and persist across run_steps
+        # calls — the standard local-optimizer-state regime of a
+        # parameter-averaging cluster (contributions carry params only);
+        # a pure function of the deterministic batch stream, so
+        # simulate_elastic stays an exact oracle when every worker uses
+        # the same knobs.
+        self.optimizer = optimizer
         self.skipped_steps = 0
         self._step = None
         self._mesh = None
+        self._opt_state = None
 
     def init_params(self):
         import jax
@@ -249,6 +266,12 @@ class SyntheticRegressionModel(ElasticModel):
 
         return GuardConfig(clip_norm=self.clip_norm)
 
+    def _opt_config(self):
+        from deeplearning4j_tpu.optimize.updaters import OptimizerConfig
+
+        cfg = OptimizerConfig.coerce(self.optimizer)
+        return cfg.resolved() if cfg is not None else None
+
     def _build(self):
         import jax
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -261,6 +284,42 @@ class SyntheticRegressionModel(ElasticModel):
         lr = self.lr
         loss_of = self._loss_of
         guard_cfg = self._guard_config()
+        opt_cfg = self._opt_config()
+
+        if opt_cfg is not None:
+            from deeplearning4j_tpu.optimize.updaters import (
+                ZeroSharding,
+                guarded_opt_update,
+                init_opt_state,
+                opt_update,
+            )
+
+            zero = (ZeroSharding(self._mesh, "data")
+                    if opt_cfg.sharded else None)
+            if self._opt_state is None:
+                self._opt_state = init_opt_state(opt_cfg,
+                                                 self.init_params(), zero)
+
+            if guard_cfg is None:
+                def step(params, opt_state, x, y):
+                    loss, grads = jax.value_and_grad(loss_of)(params, x, y)
+                    new, state = opt_update(opt_cfg, params, grads,
+                                            opt_state, lr, zero=zero)
+                    return new, state, loss
+            else:
+                def step(params, opt_state, x, y):
+                    loss, grads = jax.value_and_grad(loss_of)(params, x, y)
+                    new, state, gm = guarded_opt_update(
+                        params, grads, opt_state, loss, lr, opt_cfg,
+                        guard_cfg, zero=zero)
+                    return new, state, loss, gm["nonfinite"]
+
+            from deeplearning4j_tpu.telemetry.xprofile import maybe_profiled
+
+            self._step = maybe_profiled(
+                jax.jit(step, donate_argnums=(0, 1)), self.profile,
+                "elastic_worker")
+            return
 
         if guard_cfg is None:
             def step(params, x, y):
@@ -333,19 +392,26 @@ class SyntheticRegressionModel(ElasticModel):
             self._build()
         params = jax.device_put(
             jax.tree_util.tree_map(np.asarray, params), self._rep_sharding)
+        has_opt = self._opt_state is not None
         loss = None
         nonfinite_flags = []  # device scalars; ONE fetch after the loop
         for i in range(int(n_steps)):
             x, y = self._batch_for(worker_seed, start_step + i)
-            out = self._step(
-                params,
-                jax.device_put(x, self._batch_sharding),
-                jax.device_put(y, self._batch_sharding))
-            if self.guard:
-                params, loss, nf = out
-                nonfinite_flags.append(nf)
+            xs = jax.device_put(x, self._batch_sharding)
+            ys = jax.device_put(y, self._batch_sharding)
+            if has_opt:
+                out = self._step(params, self._opt_state, xs, ys)
+                params, self._opt_state = out[0], out[1]
+                loss = out[2]
+                if self.guard:
+                    nonfinite_flags.append(out[3])
             else:
-                params, loss = out
+                out = self._step(params, xs, ys)
+                if self.guard:
+                    params, loss, nf = out
+                    nonfinite_flags.append(nf)
+                else:
+                    params, loss = out
         host = jax.tree_util.tree_map(np.asarray, jax.device_get(params))
         if nonfinite_flags:
             self.skipped_steps += int(sum(

@@ -162,8 +162,13 @@ def summarize_step_log(records: List[Dict]) -> Dict:
     tps = series("tokens_per_sec")
     if tps:
         out["tokens_per_sec_mean"] = round(statistics.fmean(tps), 1)
+    # moment_norm_* / lamb_trust_ratio: the ISSUE 13 optimizer-health
+    # block (optimize/updaters.opt_update(with_metrics=True)) — absent on
+    # plain-SGD runs, so the rows are simply omitted (silent-when-absent
+    # pinned both ways in tests/test_updaters.py)
     for key in ("loss", "score", "grad_norm", "param_norm", "update_ratio",
-                "moe_dropped_frac"):
+                "moe_dropped_frac", "moment_norm_m", "moment_norm_v",
+                "lamb_trust_ratio"):
         vals = series(key)
         if vals:
             out[key] = {"first": round(vals[0], 6), "last": round(vals[-1], 6)}

@@ -298,6 +298,103 @@ def test_grouped_expert_cross_g_resume(tmp_path):
     _assert_close(resumed, ref, "G=4 → G=1 → single-device resume params")
 
 
+# ------------------------------------------- optimizer-state resume ----
+
+def test_adam_sharded_kill_resume_with_moments(tmp_path):
+    """ISSUE 13 acceptance: a dp2×ep4 Adam run with the ZeRO-sharded
+    update checkpointed at step 3 (params + CANONICAL moment trees via
+    ``updaters.canonical_opt_state``), killed, and resumed twice — (a)
+    same mesh, moments re-partitioned into the ZeRO layout, and (b)
+    CROSS-MESH onto a single device with the replicated update (the
+    moment trees reshard exactly like their params) — must match the
+    uninterrupted run's losses and final params ≤1e-6. An Adam resume
+    that dropped or zeroed the moments visibly diverges (the bias
+    correction restarts), so this parity is what makes optimizer
+    checkpoints real."""
+    from deeplearning4j_tpu.models.transformer_lm import (
+        init_lm_opt_state,
+        lm_update_sharding,
+    )
+    from deeplearning4j_tpu.optimize.updaters import (
+        OptimizerConfig,
+        canonical_opt_state,
+        init_opt_state,
+        opt_state_shardings,
+        partition_opt_state,
+    )
+
+    mesh = _dp_ep_mesh()
+    capacity = (B // 2) * T
+    cfg = OptimizerConfig(name="adam", lr=1e-3, update_sharding="sharded")
+    zero = lm_update_sharding(mesh)
+
+    def run(params, opt_state, step_fn, start, n, losses):
+        for i in range(start, start + n):
+            tk, tg = shard_lm_batch(*_step_data(i), mesh)
+            params, opt_state, loss = step_fn(params, opt_state, tk, tg)
+            jax.block_until_ready(loss)
+            losses.append(float(loss))
+        return params, opt_state
+
+    # uninterrupted: 6 sharded-update steps
+    step = make_composed_train_step(mesh, H, capacity, optimizer=cfg)
+    ref_losses = []
+    rp = shard_lm_params(_params(), mesh)
+    rp, rst = run(rp, init_lm_opt_state(cfg, rp, mesh), step, 0, 6,
+                  ref_losses)
+
+    # interrupted twin: 3 steps, save params + canonical moments, KILL
+    ck = _ck(tmp_path)
+    mid_losses = []
+    mp = shard_lm_params(_params(), mesh)
+    mp, mst = run(mp, init_lm_opt_state(cfg, mp, mesh), step, 0, 3,
+                  mid_losses)
+    ck.save(3, {"params": mp, "opt": canonical_opt_state(mst, mp, zero)},
+            mesh=mesh)
+    del mp, mst
+
+    # (a) same-mesh resume: fresh builders/templates, moments
+    # re-partitioned into the ZeRO layout
+    template = {"params": _params()}
+    template["opt"] = canonical_opt_state(
+        init_opt_state(OptimizerConfig(name="adam"), template["params"]),
+        template["params"], None)
+    psh = lm_param_shardings(template["params"], mesh)
+    shardings = {"params": psh, "opt": opt_state_shardings(psh)}
+    state, resumed_step, _ = ck.restore(template, shardings)
+    assert resumed_step == 3
+    step2 = make_composed_train_step(mesh, H, capacity, optimizer=cfg)
+    res_losses = []
+    ap, ast = run(state["params"], partition_opt_state(state["opt"], zero),
+                  step2, 3, 3, res_losses)
+    np.testing.assert_allclose(mid_losses + res_losses, ref_losses,
+                               atol=ATOL, rtol=0)
+    _assert_close(ap, rp, "adam same-mesh resume params")
+    can_a = canonical_opt_state(ast, ap, zero)
+    can_r = canonical_opt_state(rst, rp, zero)
+    _assert_close(can_a["m"], can_r["m"], "adam resumed first moments")
+    _assert_close(can_a["v"], can_r["v"], "adam resumed second moments")
+    assert int(can_a["count"]) == int(can_r["count"]) == 6
+
+    # (b) cross-mesh: unsharded single-device resume, replicated update —
+    # identical math, so the trajectory must still track the dp×ep run
+    state2, got2, _ = ck.restore(
+        {"params": _params(), "opt": template["opt"]}, shardings=None)
+    assert got2 == 3
+    rep = OptimizerConfig(name="adam", lr=1e-3)
+    sd = make_single_device_train_step(H, optimizer=rep)
+    sp = jax.tree_util.tree_map(jnp.asarray, state2["params"])
+    sst = jax.tree_util.tree_map(jnp.asarray, state2["opt"])
+    sd_losses = []
+    for i in range(3, 6):
+        tk, tg = _step_data(i)
+        sp, sst, loss = sd(sp, sst, tk, tg)
+        sd_losses.append(float(loss))
+    np.testing.assert_allclose(sd_losses, ref_losses[3:], atol=ATOL,
+                               rtol=0)
+    _assert_close(sp, jax.device_get(rp), "adam cross-mesh resume params")
+
+
 # ------------------------------------------------------- trainer facade ----
 
 def _mlp_conf(num_iterations=1, dropout=0.0, seed=11):

@@ -49,9 +49,11 @@ from typing import Dict, List, Optional
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # stage metrics worth tracking round over round: rates, MFU, A/B ratios
+# (peak_bytes_ratio: ISSUE 13's replicated/sharded optimizer footprint
+# headline — HIGHER is better, a shrinking ratio means the ZeRO win eroded)
 _METRIC_RE = re.compile(
     r"_(?:per_sec|per_chip|mfu|vs_cpu|vs_single|vs_densecore|vs_baseline|"
-    r"blocking_vs_background|overhead_pct)$")
+    r"blocking_vs_background|overhead_pct|peak_bytes_ratio)$")
 # metrics where an INCREASE is the regression (ISSUE 9 footprint rows,
 # ISSUE 10 serving-latency rows)
 _LOWER_IS_BETTER_RE = re.compile(

@@ -52,9 +52,45 @@ def resolve_step_dir(path: str) -> str:
     return step_dir
 
 
+def _leaf_bytes(entry) -> int:
+    n = 1
+    for d in entry.shape:
+        n *= int(d)
+    try:
+        return n * np.dtype(entry.dtype).itemsize
+    except TypeError:  # extension dtypes (bfloat16): 2 bytes
+        return n * 2
+
+
+def optimizer_summary(manifest) -> dict | None:
+    """Optimizer-state block for manifests that carry one (ISSUE 13: the
+    ``['opt']`` subtree — canonical moment trees + step count saved next
+    to the params): leaf/byte counts per moment tree plus the save-time
+    sharding specs, so an operator can see at a glance whether a
+    checkpoint restores moments and how they were laid out. ``None``
+    when the checkpoint has no optimizer state (a plain-SGD save renders
+    exactly as before)."""
+    opt = [e for e in manifest.leaves if e.path.startswith("['opt']")]
+    if not opt:
+        return None
+    moments = sorted({e.path.split("']")[1][2:] for e in opt
+                      if e.path.count("[") > 1})
+    specs = sorted({json.dumps(e.spec) for e in opt}, key=str)
+    out = {
+        "leaves": len(opt),
+        "bytes": sum(_leaf_bytes(e) for e in opt),
+        "moments": [m for m in moments if m not in ("count",)],
+        "shardings": [json.loads(s) for s in specs],
+    }
+    count = next((e for e in opt if e.path == "['opt']['count']"), None)
+    if count is not None:
+        out["has_step_count"] = True
+    return out
+
+
 def summarize(step_dir: str) -> dict:
     m = read_manifest(step_dir)
-    return {
+    out = {
         "dir": step_dir,
         "format": m.format,
         "step": m.step,
@@ -65,6 +101,10 @@ def summarize(step_dir: str) -> dict:
         "files": len(m.files),
         "bytes": m.total_bytes,
     }
+    opt = optimizer_summary(m)
+    if opt is not None:
+        out["optimizer_state"] = opt
+    return out
 
 
 def format_summary(step_dir: str) -> str:
@@ -76,6 +116,14 @@ def format_summary(step_dir: str) -> str:
              f"  {s['leaves']} leaves, {s['chunks']} chunks, "
              f"{s['files']} shard files, {s['bytes'] / 1e6:.2f} MB",
              f"  meta: {', '.join(s['meta_keys']) or '(none)'}"]
+    opt = s.get("optimizer_state")
+    if opt:
+        lines.append(
+            f"  optimizer state: {opt['leaves']} leaves "
+            f"({', '.join(opt['moments'])}"
+            f"{' + step count' if opt.get('has_step_count') else ''}), "
+            f"{opt['bytes'] / 1e6:.2f} MB, "
+            f"shardings {opt['shardings']}")
     for entry in m.leaves:
         spec = "" if entry.spec is None else f"  spec={entry.spec}"
         lines.append(f"  {entry.path}  {list(entry.shape)} {entry.dtype}"

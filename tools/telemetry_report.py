@@ -39,7 +39,11 @@ def format_report(summary: dict, path: str) -> str:
                      f"{wall.get('p99', '-')} / {wall['mean']}"))
     if "tokens_per_sec_mean" in summary:
         rows.append(("tokens/s (mean)", str(summary["tokens_per_sec_mean"])))
-    for key in ("loss", "score", "grad_norm", "param_norm", "update_ratio"):
+    # moment_norm_m/v + lamb_trust_ratio: the ISSUE 13 optimizer-health
+    # block — rendered only when the run carried an in-graph optimizer
+    # (silent-when-absent pinned both ways in tests/test_updaters.py)
+    for key in ("loss", "score", "grad_norm", "param_norm", "update_ratio",
+                "moment_norm_m", "moment_norm_v", "lamb_trust_ratio"):
         if key in summary:
             s = summary[key]
             rows.append((f"{key} (first -> last)",
