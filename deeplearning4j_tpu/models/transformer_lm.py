@@ -277,7 +277,8 @@ def composed_loss_fn(mesh: Mesh, n_heads: int, capacity: int,
                      top_k: int = 2, aux_weight: float = 1e-2,
                      attn_impl: Optional[str] = None,
                      moe_impl: Optional[str] = None,
-                     with_metrics: bool = False):
+                     with_metrics: bool = False,
+                     ring_prefetch: bool = True):
     """Loss with the parallel strategies the mesh's axes call for:
     "data" → batch sharding (GSPMD), "sp" → ring attention over the
     sequence, "expert" → expert-parallel MoE dispatch (grouped: any
@@ -286,9 +287,13 @@ def composed_loss_fn(mesh: Mesh, n_heads: int, capacity: int,
     dp×ep; ("data","sp","expert") composes all three. ``attn_impl`` forces
     the attention core on BOTH paths (the ring's per-rotated-block core and
     the unsharded core); ``moe_impl`` forces the MoE dispatch
-    ("alltoall" | "replicated"); both default to their override/env/auto
-    chains. ``with_metrics`` returns the (loss, metrics) twin — the
-    router-load fraction is computed on the GLOBAL (GSPMD-sharded)
+    ("alltoall" | "alltoall_2d" | "replicated" — the 2D factorization is
+    ISSUE 14's hierarchical exchange, parallel/moe.py); both default to
+    their override/env/auto chains. ``ring_prefetch`` (ISSUE 14, default
+    True) rotates the next K/V block under the current block's tiles —
+    ``False`` restores the rotate-after-attend oracle, bit-identical
+    values either way. ``with_metrics`` returns the (loss, metrics) twin
+    — the router-load fraction is computed on the GLOBAL (GSPMD-sharded)
     activations, so it reports the same global balance the dense oracle
     sees, and the capacity paths add ``moe_dropped_frac`` (the overflow
     share under the resolved dispatch's sub-shard semantics).
@@ -298,7 +303,7 @@ def composed_loss_fn(mesh: Mesh, n_heads: int, capacity: int,
         attn_core_fn = lambda q, k, v: ring_attention(  # noqa: E731
             q, k, v, mesh, SEQ_AXIS, causal=True,
             batch_axis=DATA_AXIS if DATA_AXIS in names else None,
-            attn_impl=attn_impl)
+            attn_impl=attn_impl, prefetch=ring_prefetch)
     else:
         attn_core_fn = lambda q, k, v: attention_core(  # noqa: E731
             q, k, v, causal=True, impl=attn_impl)
@@ -566,13 +571,17 @@ def make_composed_train_step(mesh: Mesh, n_heads: int, capacity: int,
                              moe_impl: Optional[str] = None,
                              with_metrics: bool = False,
                              donate: bool = False, guard=None,
-                             profile=None, optimizer=None):
+                             profile=None, optimizer=None,
+                             ring_prefetch: bool = True):
     """SGD step over the composed mesh: step(params, tokens, targets) ->
     (new_params, loss). Shard inputs with shard_lm_params/shard_lm_batch
     first; GSPMD + the shard_map transposes insert every collective
     (grad AllReduce over data/sp, expert-grad reduce over token axes,
     K/V ppermute ring, and the MoE combine — capacity all_to_all exchange
-    or dense psum per ``moe_impl``; see parallel/moe.py).
+    (flat or the ``"alltoall_2d"`` hierarchical factorization) or dense
+    psum per ``moe_impl``; see parallel/moe.py). ``ring_prefetch=False``
+    restores the rotate-after-attend ring body (ISSUE 14 A/B oracle;
+    bit-identical either way).
 
     ``with_metrics=True`` returns (new_params, loss, metrics) where metrics
     is an in-graph dict (loss, task/aux split, grad_norm, param_norm,
@@ -610,7 +619,8 @@ def make_composed_train_step(mesh: Mesh, n_heads: int, capacity: int,
 
     loss_fn = composed_loss_fn(mesh, n_heads, capacity, top_k, aux_weight,
                                attn_impl=attn_impl, moe_impl=moe_impl,
-                               with_metrics=with_metrics)
+                               with_metrics=with_metrics,
+                               ring_prefetch=ring_prefetch)
     label = "lm_composed[" + "x".join(mesh.axis_names) + "]"
     opt_cfg = OptimizerConfig.coerce(optimizer)
     if opt_cfg is not None:
@@ -717,7 +727,8 @@ def make_pp_stages(params: dict, n_heads: int, n_stages: int = 2,
 
 def make_pp_loss(stage_fn, mesh: Mesh, pipe_axis: str,
                  batch_axis: Optional[str] = None,
-                 with_metrics: bool = False):
+                 with_metrics: bool = False,
+                 overlap: bool = False):
     """Staged-LM task loss for the dp×pp path — embed lookup, the pipeline
     schedule over ``pipe_axis``, decoder, mean NLL. The dense twin is
     ``dense_loss_fn(n_heads, aux_weight=0.0)`` on the flattened
@@ -738,7 +749,7 @@ def make_pp_loss(stage_fn, mesh: Mesh, pipe_axis: str,
         stacked, embed, dec_w, dec_b = trained
         x_mbs = embed[toks_mbs]  # (M, mb, T, d)
         outs = pipeline_apply(stacked, x_mbs, stage_fn, mesh, pipe_axis,
-                              batch_axis=batch_axis)
+                              batch_axis=batch_axis, overlap=overlap)
         logits = outs @ dec_w + dec_b
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, tgt_mbs[..., None], -1)[..., 0]

@@ -7,7 +7,7 @@ rows [e] of the stacked (E, ...) param leaves, device d owns the contiguous
 local group [d·G, (d+1)·G), and expert compute is a batched ``vmap`` over the
 local group (the Switch-Transformer scaling move: more experts than chips).
 
-Two dispatch implementations behind one seam (``moe_apply(impl=...)``):
+Three dispatch implementations behind one seam (``moe_apply(impl=...)``):
 
 - ``"alltoall"`` — the GShard shape (arXiv:2006.16668; the portable
   collective-redistribution pattern of Zhuang et al., arXiv:2112.01075).
@@ -19,6 +19,29 @@ Two dispatch implementations behind one seam (``moe_apply(impl=...)``):
   returns results by the inverse all_to_all. Per-device exchange volume is
   O(E·C·d) — proportional to how many tokens the experts actually accept —
   and router FLOPs are O(n_local·E).
+- ``"alltoall_2d"`` (ISSUE 14) — the hierarchical factorization of the
+  flat exchange per arXiv:2112.01075: the p-device expert axis is split
+  into a ``(outer, inner)`` grid (``factor_expert_axis`` — balanced, and
+  LOUDLY rejected when p has no nontrivial factorization) and each flat
+  all_to_all becomes two grouped phases, intra-group over the ``inner``
+  consecutive devices then inter-group over the ``outer`` stride-``inner``
+  peers (``lax.all_to_all(axis_index_groups=...)``). The routed VALUES are
+  bit-identical to the flat dispatch — only the wire schedule changes.
+
+  Wire-byte model (ring convention, B = E·C·d·itemsize the per-device
+  exchange buffer; checked against the xprofile HLO inventory in
+  tests/test_xprofile.py):
+
+      flat          (p−1)/p · B     in p−1 messages of B/p
+      2d intra      (i−1)/i · B     in i−1 messages of B/i   (fast links)
+      2d inter      (o−1)/o · B     in o−1 messages of B/o   (slow links)
+
+  Per HLO collective the factorized ops are strictly smaller — group size
+  i (resp. o) < p and per-op wire bytes (i−1)/i·B < (p−1)/p·B. The
+  cross-group (slow-link) traffic is byte-identical to the flat op's
+  ((p−i)/p·B = (o−1)/o·B) but aggregated into i× fewer, i×-larger
+  messages — the multi-pod win: intra-pod ICI absorbs an extra
+  (i−1)/i·B so the DCN hop count drops from p−i to o−1 per device.
 - ``"replicated"`` — the historical path: tokens replicated along the
   expert axis, every device runs the router over its whole token row, each
   device gathers the first C tokens routed to each of its experts, and a
@@ -30,7 +53,8 @@ Two dispatch implementations behind one seam (``moe_apply(impl=...)``):
 Selection precedence (mirrors ops/flash_attention's ``attn_impl`` chain):
 per-call ``impl=`` > ``set_moe_impl`` > the ``DL4J_TPU_MOE_IMPL`` env var >
 auto (alltoall whenever the token dim divides over token_axes × the expert
-axis, else replicated).
+axis, else replicated — ``alltoall_2d`` is always an explicit opt-in, the
+auto gate never guesses a topology).
 
 Capacity math: capacity C bounds tokens PER (expert, token-sub-shard);
 overflow routes are dropped (outputs exactly zero — callers add their own
@@ -75,17 +99,19 @@ EXPERT_AXIS = "expert"
 # dispatch-impl seam (same precedence shape as ops/flash_attention):
 # per-call impl= > set_moe_impl > DL4J_TPU_MOE_IMPL env > auto
 MOE_IMPL_ENV = "DL4J_TPU_MOE_IMPL"
-_IMPLS = ("alltoall", "replicated")
+_IMPLS = ("alltoall", "alltoall_2d", "replicated")
 _impl_override: Optional[str] = None
 
 
 def set_moe_impl(impl: Optional[str]) -> None:
     """Force the MoE dispatch: "alltoall" (capacity-buffer exchange,
-    tokens sharded over the expert axis too), "replicated" (replicated
-    tokens + dense psum combine), or None for auto."""
+    tokens sharded over the expert axis too), "alltoall_2d" (the same
+    exchange factorized into intra+inter grouped phases — module
+    docstring), "replicated" (replicated tokens + dense psum combine), or
+    None for auto."""
     if impl not in (None,) + _IMPLS:
         raise ValueError(f"unknown moe impl {impl!r}; "
-                         "options: alltoall, replicated, None")
+                         "options: alltoall, alltoall_2d, replicated, None")
     global _impl_override
     _impl_override = impl
 
@@ -127,7 +153,55 @@ def route_shards(mesh: Mesh, token_axes: tuple = (), axis: str = EXPERT_AXIS,
     rows = math.prod(mesh.shape[a] for a in token_axes) if token_axes else 1
     n_dev = mesh.shape[axis]
     eff = resolve_moe_impl(n_tokens, rows * n_dev, impl)
-    return rows * n_dev if eff == "alltoall" else rows
+    # alltoall_2d routes per device exactly like the flat exchange — only
+    # the wire schedule differs, never the capacity semantics
+    return rows * n_dev if (eff or "").startswith("alltoall") else rows
+
+
+def factor_expert_axis(n_dev: int) -> tuple:
+    """The balanced ``(outer, inner)`` grid the 2D dispatch factorizes a
+    p-device expert axis into: ``inner`` is the largest divisor of p with
+    inner² ≤ p (so inner ≤ outer and outer·inner = p). A prime (or < 4)
+    axis size has no nontrivial grid and raises LOUDLY — the caller must
+    fall back to the flat ``"alltoall"`` dispatch, never a silently
+    degenerate 1×p factorization."""
+    n_dev = int(n_dev)
+    inner = 0
+    for d in range(2, int(math.isqrt(n_dev)) + 1):
+        if n_dev % d == 0:
+            inner = d
+    if n_dev < 4 or inner == 0:
+        raise ValueError(
+            f"expert axis size {n_dev} is not factorizable into an "
+            "(outer, inner) grid with both factors >= 2 — alltoall_2d "
+            "needs a composite axis size; use impl='alltoall' instead")
+    return n_dev // inner, inner
+
+
+def _a2a_hierarchical(x, axis_name: str, outer: int, inner: int,
+                      scope: str):
+    """Two-phase factorized all_to_all of a per-device ``(n_dev, ...)``
+    buffer (``x[dst]`` destined for device ``dst``; returns ``y[src]``
+    received from device ``src``) — bit-compatible with the flat tiled
+    ``lax.all_to_all(split_axis=0, concat_axis=0)``.
+
+    Device d sits at grid position (o, i) = (d // inner, d % inner).
+    Phase 1 exchanges within each run of ``inner`` consecutive devices
+    (moving every chunk to its destination's inner coordinate); phase 2
+    exchanges across the ``outer`` stride-``inner`` peers (delivering to
+    the destination's outer coordinate). See the module docstring for the
+    per-phase wire model."""
+    n_dev = outer * inner
+    intra = [[o * inner + i for i in range(inner)] for o in range(outer)]
+    inter = [[o * inner + i for o in range(outer)] for i in range(inner)]
+    s = x.reshape((outer, inner) + x.shape[1:])
+    with jax.named_scope(f"{scope}_intra"):
+        s = jax.lax.all_to_all(s, axis_name, split_axis=1, concat_axis=1,
+                               tiled=True, axis_index_groups=intra)
+    with jax.named_scope(f"{scope}_inter"):
+        s = jax.lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0,
+                               tiled=True, axis_index_groups=inter)
+    return s.reshape((n_dev,) + x.shape[1:])
 
 
 def _routing(logits, top_k: int):
@@ -182,7 +256,7 @@ def _dispatch_replicated(local_params, router_w, x, capacity: int,
 
 def _dispatch_alltoall(local_params, router_w, x, capacity: int,
                        axis_name: str, expert_fn: Callable, top_k: int,
-                       group: int, n_dev: int):
+                       group: int, n_dev: int, split: Optional[tuple] = None):
     """Per-device body under shard_map. x: (n_local, d) — this device's OWN
     token slice (sharded over token_axes AND the expert axis); experts
     exchange capacity buffers instead of psumming dense outputs.
@@ -190,6 +264,10 @@ def _dispatch_alltoall(local_params, router_w, x, capacity: int,
     Route ranking is the GShard cumsum-of-one-hot: rank r of a (token,
     choice) route within its expert = how many earlier routes chose the
     same expert; routes with r ≥ C are dropped (gate zeroed, output zero).
+
+    ``split=(outer, inner)`` swaps each flat exchange for the two-phase
+    hierarchical factorization (``_a2a_hierarchical``) — identical values,
+    grouped wire schedule (the "alltoall_2d" impl).
     """
     n, d = x.shape
     n_experts = n_dev * group
@@ -209,15 +287,23 @@ def _dispatch_alltoall(local_params, router_w, x, capacity: int,
     buf = buf.at[slot].add(x[tok_ids])  # kept slots are unique: add == set
     send = buf[: n_experts * capacity].reshape(n_dev, group, capacity, d)
     with jax.named_scope("moe_all2all_dispatch"):
-        recv = jax.lax.all_to_all(send, axis_name, split_axis=0,
-                                  concat_axis=0, tiled=True)
+        if split is not None:
+            recv = _a2a_hierarchical(send, axis_name, split[0], split[1],
+                                     "moe_all2all_dispatch")
+        else:
+            recv = jax.lax.all_to_all(send, axis_name, split_axis=0,
+                                      concat_axis=0, tiled=True)
     # recv[s, g]: source device s's capacity slab for my local expert g
     toks = recv.transpose(1, 0, 2, 3).reshape(group, n_dev * capacity, d)
     y = jax.vmap(expert_fn)(local_params, toks)  # O(G·n_dev·C) compute
     y = y.reshape(group, n_dev, capacity, d).transpose(1, 0, 2, 3)
     with jax.named_scope("moe_all2all_return"):
-        back = jax.lax.all_to_all(y, axis_name, split_axis=0,
-                                  concat_axis=0, tiled=True)
+        if split is not None:
+            back = _a2a_hierarchical(y, axis_name, split[0], split[1],
+                                     "moe_all2all_return")
+        else:
+            back = jax.lax.all_to_all(y, axis_name, split_axis=0,
+                                      concat_axis=0, tiled=True)
     # back reshaped (E·C, d) lines up with `slot`: back[dst, g, r] is the
     # output of my route parked at slot (dst·G + g)·C + r
     ybuf = jnp.concatenate([back.reshape(n_experts * capacity, d),
@@ -245,9 +331,11 @@ def moe_apply(router_w: Array, expert_params, x: Array, mesh: Mesh,
     ``token_axes`` composes dp/sp×ep on a multi-axis mesh: the token dim N
     is sharded over those mesh axes, so each token shard routes its own
     tokens to the full expert set. ``impl`` selects the dispatch for THIS
-    call (else the set_moe_impl/env/auto chain — see module docstring for
-    the two paths' comm shapes and capacity semantics). Expert-param
-    gradients are psummed over the token axes automatically by shard_map's
+    call — "alltoall", "alltoall_2d" (the hierarchical two-phase
+    factorization; expert-axis size must be composite), or "replicated" —
+    else the set_moe_impl/env/auto chain (see module docstring for the
+    paths' comm shapes and capacity semantics). Expert-param gradients
+    are psummed over the token axes automatically by shard_map's
     transpose.
     """
     if top_k not in (1, 2):
@@ -276,17 +364,21 @@ def moe_apply(router_w: Array, expert_params, x: Array, mesh: Mesh,
     eff = resolve_moe_impl(n_tokens, rows * n_dev, impl)
     param_spec = jax.tree_util.tree_map(lambda _: P(axis), expert_params)
 
-    if eff == "alltoall":
+    if eff in ("alltoall", "alltoall_2d"):
         if n_tokens % (rows * n_dev):
             raise ValueError(
-                f"alltoall dispatch needs the token dim ({n_tokens}) to "
+                f"{eff} dispatch needs the token dim ({n_tokens}) to "
                 f"divide over token_axes × {axis!r} ({rows}×{n_dev}); pass "
                 "impl='replicated' or pad the token stream")
+        # alltoall_2d: resolve the (outer, inner) grid HERE — a prime
+        # axis size fails the call loudly, not inside the traced body
+        split = factor_expert_axis(n_dev) if eff == "alltoall_2d" else None
         tok_spec = P(tuple(token_axes) + (axis,))
 
         def body(params, rw, xs):
             return _dispatch_alltoall(params, rw, xs, capacity, axis,
-                                      expert_fn, top_k, group, n_dev)
+                                      expert_fn, top_k, group, n_dev,
+                                      split=split)
     else:
         tok_spec = P(tuple(token_axes) if token_axes else None)
 

@@ -33,7 +33,8 @@ Array = jax.Array
 PIPE_AXIS = "pipe"
 
 
-def _pipeline_body(stage_params, x_mbs, stage_fn, axis_name: str):
+def _pipeline_body(stage_params, x_mbs, stage_fn, axis_name: str,
+                   overlap: bool = False):
     """Per-device schedule under shard_map.
 
     stage_params: this stage's params (leading stage axis of size 1 removed
@@ -42,40 +43,80 @@ def _pipeline_body(stage_params, x_mbs, stage_fn, axis_name: str):
     dense stacks, (T, d) for sequence models — replicated over the pipe axis
     (only stage 0 reads them). Returns (M, mb, ...): the pipeline output,
     replicated via psum (only the last stage contributes non-zeros).
+
+    ``overlap=False`` is the STRICT tick schedule (M + S − 1 ticks): each
+    tick computes a stage and then ppermutes its output — the rotate is
+    data-dependent on the same tick's compute, so comm strictly serializes
+    against compute.
+
+    ``overlap=True`` (ISSUE 14) is the double-buffered handoff: each tick
+    FIRST issues the ppermute of the PREVIOUS tick's output (a value
+    already sitting in the scan carry — no data dependence on this tick's
+    stage compute, so the collective-permute can fly under the stage math)
+    and computes on the buffer received the tick before. A stage-to-stage
+    hop therefore takes two ticks — microbatch m reaches stage s at tick
+    m + 2s, the schedule runs M + 2(S − 1) ticks — but every tick's
+    rotate overlaps its compute. The per-(stage, microbatch) inputs are
+    IDENTICAL to the strict schedule's, extra ticks contribute exact
+    zeros, so loss AND gradients are bit-identical (pinned in
+    tests/test_pipeline.py).
     """
     n_stages = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     n_micro = x_mbs.shape[0]
-    ticks = n_micro + n_stages - 1
+    hop = 2 if overlap else 1  # ticks per stage-to-stage handoff
+    ticks = n_micro + hop * (n_stages - 1)
     fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def _write_out(outputs, y, t):
+        # the last stage finishes microbatch (t − hop·(S−1)) at tick t
+        out_idx = t - hop * (n_stages - 1)
+        write = (my == n_stages - 1) & (out_idx >= 0)
+        return jax.lax.dynamic_update_index_in_dim(
+            outputs,
+            jnp.where(write, y, jax.lax.dynamic_index_in_dim(
+                outputs, jnp.maximum(out_idx, 0), axis=0, keepdims=False)),
+            jnp.maximum(out_idx, 0), axis=0)
+
+    def _feed(t):
+        # stage 0 ingests microbatch t (clamped; masked when t >= M)
+        return jax.lax.dynamic_index_in_dim(
+            x_mbs, jnp.minimum(t, n_micro - 1), axis=0, keepdims=False)
 
     def tick(carry, t):
         recv, outputs = carry
-        # stage 0 ingests microbatch t (clamped; masked when t >= M)
-        feed = jax.lax.dynamic_index_in_dim(
-            x_mbs, jnp.minimum(t, n_micro - 1), axis=0, keepdims=False)
-        x_in = jnp.where(my == 0, feed, recv)
+        x_in = jnp.where(my == 0, _feed(t), recv)
         # XProf phase naming: each device's row shows its own stage id, so
         # "pp_stage_compute" per tick + the ppermute scope below make the
         # bubble structure readable straight off the timeline
         with jax.named_scope("pp_stage_compute"):
             y = stage_fn(stage_params, x_in)
-        # the last stage finishes microbatch (t − S + 1) at tick t
-        out_idx = t - (n_stages - 1)
-        write = (my == n_stages - 1) & (out_idx >= 0)
-        outputs = jax.lax.dynamic_update_index_in_dim(
-            outputs,
-            jnp.where(write, y, jax.lax.dynamic_index_in_dim(
-                outputs, jnp.maximum(out_idx, 0), axis=0, keepdims=False)),
-            jnp.maximum(out_idx, 0), axis=0)
+        outputs = _write_out(outputs, y, t)
         # shift activations one stage forward (ring; stage 0's recv is unused)
         with jax.named_scope("pp_activation_ppermute"):
             recv_next = jax.lax.ppermute(y, axis_name, fwd)
         return (recv_next, outputs), None
 
+    def tick_overlap(carry, t):
+        y_prev, recv, outputs = carry
+        # the rotate goes FIRST and reads only carried state — XLA is free
+        # to run it concurrently with this tick's stage compute below
+        with jax.named_scope("pp_activation_ppermute"):
+            recv_next = jax.lax.ppermute(y_prev, axis_name, fwd)
+        x_in = jnp.where(my == 0, _feed(t), recv)
+        with jax.named_scope("pp_stage_compute"):
+            y = stage_fn(stage_params, x_in)
+        outputs = _write_out(outputs, y, t)
+        return (y, recv_next, outputs), None
+
     recv0 = jnp.zeros(x_mbs.shape[1:], x_mbs.dtype)
     out0 = jnp.zeros(x_mbs.shape, x_mbs.dtype)
-    (_, outputs), _ = jax.lax.scan(tick, (recv0, out0), jnp.arange(ticks))
+    if overlap:
+        (_, _, outputs), _ = jax.lax.scan(
+            tick_overlap, (recv0, recv0, out0), jnp.arange(ticks))
+    else:
+        (_, outputs), _ = jax.lax.scan(tick, (recv0, out0),
+                                       jnp.arange(ticks))
     # replicate the last stage's outputs everywhere (other stages hold zeros)
     mask = (my == n_stages - 1).astype(x_mbs.dtype)
     return jax.lax.psum(outputs * mask, axis_name)
@@ -83,7 +124,8 @@ def _pipeline_body(stage_params, x_mbs, stage_fn, axis_name: str):
 
 def pipeline_apply(stage_params, x_mbs: Array, stage_fn: Callable,
                    mesh: Mesh, axis: str = PIPE_AXIS,
-                   batch_axis: "str | None" = None) -> Array:
+                   batch_axis: "str | None" = None,
+                   overlap: bool = False) -> Array:
     """Run microbatches through the stage pipeline.
 
     stage_params: pytree whose leaves have a leading STAGE axis of size S
@@ -97,6 +139,10 @@ def pipeline_apply(stage_params, x_mbs: Array, stage_fn: Callable,
     within the row). Gradients for the stage params are psummed over the
     batch axis automatically by shard_map's transpose (params are
     replicated along it).
+
+    ``overlap=True`` runs the double-buffered handoff schedule — the
+    stage ppermute is issued for the PREVIOUS tick's output while this
+    tick's compute runs, bit-identical outputs (see ``_pipeline_body``).
     """
     n_stages = mesh.shape[axis]
     for leaf in jax.tree_util.tree_leaves(stage_params):
@@ -111,7 +157,7 @@ def pipeline_apply(stage_params, x_mbs: Array, stage_fn: Callable,
     def body(params, x):
         # strip the per-device stage axis (size 1 after sharding)
         local = jax.tree_util.tree_map(lambda a: a[0], params)
-        return _pipeline_body(local, x, stage_fn, axis)
+        return _pipeline_body(local, x, stage_fn, axis, overlap=overlap)
 
     return shard_map(
         body, mesh=mesh,
@@ -311,7 +357,8 @@ def make_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
                              lr: float = 0.1,
                              batch_axis: "str | None" = None,
                              with_metrics: bool = False, guard=None,
-                             profile=None, optimizer=None):
+                             profile=None, optimizer=None,
+                             overlap: bool = False):
     """SGD train step over the pipelined stack.
 
     loss = mean over microbatches of ``loss_fn(y, labels_mb)`` on the
@@ -345,6 +392,13 @@ def make_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
     like their params; ``update_sharding="sharded"`` additionally shards
     the per-stage update over ``batch_axis`` (ZeRO over the dp rows of a
     dp×pp mesh). Moments donate and ride the guard skip-select bitwise.
+
+    ``overlap=True`` (ISSUE 14) swaps the strict tick schedule for the
+    double-buffered stage handoff (the ppermute for tick t's output is
+    issued while tick t+1's compute runs — see ``_pipeline_body``): loss
+    AND updated params are bit-identical to the strict schedule at the
+    same 0-compile steady retrace budget, so the knob is a pure-schedule
+    A/B (bench ``comm_overlap`` stage measures both).
     """
     from deeplearning4j_tpu.optimize.guardrails import (
         GuardConfig,
@@ -354,11 +408,12 @@ def make_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
     from deeplearning4j_tpu.telemetry.xprofile import maybe_profiled
 
     guard = GuardConfig.coerce(guard)
-    label = f"pipeline[{axis}" + (f"x{batch_axis}]" if batch_axis else "]")
+    label = (f"pipeline[{axis}" + (f"x{batch_axis}]" if batch_axis else "]")
+             + ("+overlap" if overlap else ""))
 
     def loss_of(params, x_mbs, y_mbs):
         outs = pipeline_apply(params, x_mbs, stage_fn, mesh, axis,
-                              batch_axis=batch_axis)
+                              batch_axis=batch_axis, overlap=overlap)
         per = jax.vmap(loss_fn)(outs, y_mbs)
         return jnp.mean(per), per
 

@@ -85,8 +85,17 @@ def _ring_block_core(q, k_cur, v_cur, q_offset, k_offset, causal: bool,
 
 
 def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool,
-                            impl: str = "dense"):
-    """Per-device body under shard_map. q/k/v: (B, H, T_local, D)."""
+                            impl: str = "dense", prefetch: bool = True):
+    """Per-device body under shard_map. q/k/v: (B, H, T_local, D).
+
+    ``prefetch=True`` (ISSUE 14, the default) issues the rotation of block
+    b+1 BEFORE block b's attention tiles consume the current buffer — the
+    rotate reads only the loop carry, never the attend's outputs, so
+    ordering it first lets the collective-permute fly under the flash
+    tiles (rotate-then-attend on the double buffer the carry already is).
+    ``prefetch=False`` keeps the historical rotate-after-attend trace
+    order — the parity oracle: both orders compute the IDENTICAL values
+    (pinned bitwise in tests/test_ring_attention.py)."""
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     t_local = q.shape[2]
@@ -112,14 +121,16 @@ def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool,
             new_l = l * scale_old + bl * scale_new
             return new_o, new_l, new_m
 
-        if causal:
-            # K blocks from strictly-later devices are fully masked — skip
-            # both einsums (roughly half of all (device, step) pairs)
-            o, l, m = jax.lax.cond(
-                src <= my_idx, attend, lambda o, l, m: (o, l, m), o, l, m
-            )
-        else:
-            o, l, m = attend(o, l, m)
+        def attend_maybe_skipped(o, l, m):
+            if causal:
+                # K blocks from strictly-later devices are fully masked —
+                # skip both einsums (roughly half of all (device, step)
+                # pairs)
+                return jax.lax.cond(
+                    src <= my_idx, attend, lambda o, l, m: (o, l, m), o, l, m
+                )
+            return attend(o, l, m)
+
         # rotate K/V one step around the ring (device i -> i+1); the last
         # step's blocks are never attended to, so skip that exchange
         def rotate(kv):
@@ -129,9 +140,19 @@ def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool,
                 return (jax.lax.ppermute(k_c, axis_name, perm),
                         jax.lax.ppermute(v_c, axis_name, perm))
 
-        k_nxt, v_nxt = jax.lax.cond(
-            step < axis_size - 1, rotate, lambda kv: kv, (k_cur, v_cur)
-        )
+        def do_rotate():
+            return jax.lax.cond(
+                step < axis_size - 1, rotate, lambda kv: kv, (k_cur, v_cur)
+            )
+
+        if prefetch:
+            # comm first: the next block starts rotating while this
+            # block's tiles run on the already-received buffer
+            k_nxt, v_nxt = do_rotate()
+            o, l, m = attend_maybe_skipped(o, l, m)
+        else:
+            o, l, m = attend_maybe_skipped(o, l, m)
+            k_nxt, v_nxt = do_rotate()
         return o, l, m, k_nxt, v_nxt
 
     # f32 accumulators regardless of input dtype (the blockwise core's
@@ -149,7 +170,8 @@ def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool,
 def ring_attention(q: Array, k: Array, v: Array, mesh: Mesh, axis: str,
                    causal: bool = False,
                    batch_axis: Optional[str] = None,
-                   attn_impl: Optional[str] = None) -> Array:
+                   attn_impl: Optional[str] = None,
+                   prefetch: bool = True) -> Array:
     """Multi-head attention with the SEQUENCE axis sharded over ``axis``.
 
     q/k/v: (B, H, T, D) global arrays (T divisible by the axis size).
@@ -163,6 +185,11 @@ def ring_attention(q: Array, k: Array, v: Array, mesh: Mesh, axis: str,
     default None resolves through flash_attention's override/env/auto chain
     on the LOCAL block length T/P ("flash" resolves to blockwise here — the
     fused pallas kernel is not a mergeable per-block core).
+
+    ``prefetch`` (ISSUE 14, default True) starts the rotation of block
+    b+1 before block b's tiles consume it — bit-identical values, comm
+    issued under compute; ``prefetch=False`` is the historical
+    rotate-after-attend oracle for A/B (bench ``comm_overlap`` stage).
     """
     from deeplearning4j_tpu.ops.flash_attention import resolve_attention_impl
 
@@ -172,7 +199,7 @@ def ring_attention(q: Array, k: Array, v: Array, mesh: Mesh, axis: str,
         impl = "blockwise"
     spec = P(batch_axis, None, axis, None)
     fn = partial(_ring_attention_sharded, axis_name=axis, causal=causal,
-                 impl=impl)
+                 impl=impl, prefetch=prefetch)
     sharded = shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,

@@ -16,6 +16,14 @@ single-file wrapper for single-device nets):
   each target shard is assembled from the covering saved chunks via the
   manifest offsets (``jax.make_array_from_callback``), never the full
   global array on one host.
+- ``redistribution.plan_redistribution`` / ``apply_plan`` /
+  ``redistribute_tree`` (ISSUE 14) — the LIVE twin of the resharding
+  loader: when the source arrays are already on devices (elastic rejoin
+  adoption, a serving engine cold-starting from a trainer's tree), the
+  respec runs as an explicit in-graph collective program
+  (slice/all_gather/all_to_all/ppermute steps, arXiv:2112.01075) inside
+  one jitted identity — no host round-trip. Disk restores keep the host
+  path above.
 - ``checkpointer.Checkpointer`` / ``CheckpointIterationListener`` — the
   training integration: save-every-N through the exception-safe listener
   chain, retention GC, ``latest()``/``restore()`` resume entry points, and
@@ -46,6 +54,13 @@ from deeplearning4j_tpu.scaleout.ckpt.reshard import (  # noqa: F401
     latest_step_dir,
     restore_sharded,
     verify_checksums,
+)
+from deeplearning4j_tpu.scaleout.ckpt.redistribution import (  # noqa: F401
+    RedistributionPlan,
+    apply_plan,
+    plan_redistribution,
+    redistribute,
+    redistribute_tree,
 )
 from deeplearning4j_tpu.scaleout.ckpt.checkpointer import (  # noqa: F401
     Checkpointer,

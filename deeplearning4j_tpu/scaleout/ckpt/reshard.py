@@ -20,6 +20,13 @@ tests/test_ckpt_resume.py::test_grouped_expert_cross_g_resume.
 Strictness (no silent corruption): a missing leaf, a shape mismatch, a
 lossy dtype narrowing, or an uncovered target region all raise — nothing is
 broadcast, truncated, or ``astype``-narrowed on the way in.
+
+Live twin (ISSUE 14): when the SOURCE is not a directory but a tree of
+arrays already resident on devices (elastic rejoin adoption, serve
+cold start from a live trainer), ``ckpt.redistribution`` reshards it as
+an explicit in-graph collective program instead of this module's host
+assembly — same strictness, parity ≤1e-6, zero host round-trip. Disk
+restores stay here.
 """
 
 from __future__ import annotations

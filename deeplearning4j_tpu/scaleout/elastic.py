@@ -390,8 +390,24 @@ class SyntheticRegressionModel(ElasticModel):
 
         if self._step is None:
             self._build()
-        params = jax.device_put(
-            jax.tree_util.tree_map(np.asarray, params), self._rep_sharding)
+        leaves = jax.tree_util.tree_leaves(params)
+        if leaves and all(isinstance(l, jax.Array) for l in leaves):
+            # live fast path (ISSUE 14): params already on devices (the
+            # carried tree between rounds, or an in-process adoption)
+            # respec through the in-graph redistribution plans instead of
+            # a host round-trip; noop when already replicated here
+            from deeplearning4j_tpu.scaleout.ckpt.redistribution import (
+                redistribute_tree,
+            )
+
+            params = redistribute_tree(
+                params, jax.tree_util.tree_map(
+                    lambda _: self._rep_sharding, params))
+        else:
+            # host path: blobstore-adopted trees arrive as numpy
+            params = jax.device_put(
+                jax.tree_util.tree_map(np.asarray, params),
+                self._rep_sharding)
         has_opt = self._opt_state is not None
         loss = None
         nonfinite_flags = []  # device scalars; ONE fetch after the loop

@@ -23,10 +23,13 @@ EOS / ``max_new_tokens`` / cache-page exhaustion, and the freed slot is
 reusable on the very next iteration — no batch barrier, a short request
 never waits for a long one.
 
-Weights arrive either directly (``DecodeEngine(params, n_heads)``) or from
+Weights arrive either directly (``DecodeEngine(params, n_heads)``), from
 a sharded checkpoint via the resharding loader
 (``DecodeEngine.from_checkpoint`` → ``Checkpointer.restore`` — any
-save-time mesh restores onto the serving host). The ``serve_dtype=`` seam
+save-time mesh restores onto the serving host), or from a LIVE
+device-resident tree (``DecodeEngine.from_live_params`` — ISSUE 14: the
+adoption runs through the in-graph redistribution plans of
+``scaleout.ckpt.redistribution``, device-to-device, no host gather). The ``serve_dtype=`` seam
 (serve/quant.py) prepares them: bf16 by default, ``"int8"`` for the
 weight-only-quantized A/B twin, ``None``/``"f32"`` for the parity
 precision.
@@ -234,6 +237,31 @@ class DecodeEngine:
         kwargs.setdefault("top_k", int(lm_meta.get("top_k", 2)))
         kwargs.setdefault("weight_version", f"ckpt-step-{manifest.step}")
         return cls(params, int(n_heads), **kwargs)
+
+    @classmethod
+    def from_live_params(cls, params, n_heads: int, *, device=None,
+                         **kwargs):
+        """Any-mesh cold start from a params tree ALREADY resident on
+        devices (ISSUE 14) — e.g. a live trainer's sharded flagship tree:
+        every leaf is moved onto the serving device through the in-graph
+        redistribution plans (``scaleout.ckpt.redistribution``), so the
+        adoption is device-to-device collectives, never a host gather of
+        sharded state. Disk checkpoints keep the host-assembly path
+        (``from_checkpoint``). ``device`` defaults to the first local
+        device; the resulting engine is token-identical to one built from
+        the same params via the host path (tests/test_redistribution.py).
+        """
+        from jax.sharding import SingleDeviceSharding
+
+        from deeplearning4j_tpu.scaleout.ckpt.redistribution import (
+            redistribute_tree,
+        )
+
+        dev = device if device is not None else jax.devices()[0]
+        dst = jax.tree_util.tree_map(
+            lambda _: SingleDeviceSharding(dev), params)
+        kwargs.setdefault("weight_version", "live-params")
+        return cls(redistribute_tree(params, dst), int(n_heads), **kwargs)
 
     # ---------------------------------------------------------- admission ----
     def _make_buckets(self, min_bucket: int) -> List[int]:

@@ -101,6 +101,9 @@ def _child_main(n: int, batch: int, mode: str, warmup: int = WARMUP,
         label=f"dp_sync[{n}]")
     allreduce = prof.collectives.get("all-reduce", {})
     n_allreduce = allreduce.get("count", 0)
+    # ISSUE 14: also surface the all_to_all traffic so ep-axis scaling
+    # runs capture the MoE dispatch cost (0 on the pure-dp step here)
+    alltoall = prof.collectives.get("all-to-all", {})
     param_bytes = sum(int(jnp.size(leaf)) * 4 for layer in params
                       for leaf in jax.tree_util.tree_leaves(layer))
 
@@ -132,6 +135,8 @@ def _child_main(n: int, batch: int, mode: str, warmup: int = WARMUP,
         "ms_repeats": [r / steps * 1000.0 for r in reps],
         "all_reduce_ops": n_allreduce,
         "all_reduce_wire_bytes": allreduce.get("wire_bytes", 0.0),
+        "all_to_all_ops": alltoall.get("count", 0),
+        "all_to_all_wire_bytes": alltoall.get("wire_bytes", 0.0),
         "xla_flops": prof.flops,
         "param_bytes": param_bytes,
     }), flush=True)
@@ -197,6 +202,8 @@ def main() -> None:
             "collective_only_efficiency": round(
                 single_ms / (single_ms + coll_ms), 3),
             "all_reduce_ops_per_step": dp["all_reduce_ops"],
+            "all_to_all_ops_per_step": dp["all_to_all_ops"],
+            "all_to_all_wire_bytes_per_step": dp["all_to_all_wire_bytes"],
             "global_samples_per_sec": round(gb / (dp_ms / 1000.0), 1),
         })
     r8 = rows[-1]

@@ -14,6 +14,7 @@ from deeplearning4j_tpu.parallel.moe import (
     dropped_route_fraction,
     expected_dropped,
     expert_load,
+    factor_expert_axis,
     load_balance_loss,
     moe_apply,
     moe_reference,
@@ -78,7 +79,7 @@ def _dense_jax(router_w, stacked, x, capacity, top_k=1, n_shards=1):
     return out
 
 
-@pytest.mark.parametrize("impl", ["replicated", "alltoall"])
+@pytest.mark.parametrize("impl", ["replicated", "alltoall", "alltoall_2d"])
 def test_moe_matches_dense_reference(impl):
     router_w, per_expert, x = _setup()
     mesh = _mesh()
@@ -157,6 +158,132 @@ def test_grouped_alltoall_matches_dense_with_grads(group, top_k):
     for k in ("w", "b"):
         err = float(jnp.max(jnp.abs(jnp.asarray(ge_s[k]) - ge_d[k])))
         assert err < 1e-5, (k, err)
+
+
+@pytest.mark.parametrize("group", [1, 4])
+@pytest.mark.parametrize("top_k", [1, 2])
+def test_alltoall_2d_matches_flat(group, top_k):
+    """ISSUE 14 tentpole parity: the hierarchical 2-phase dispatch vs the
+    flat exchange at G ∈ {1, 4} × top-k ∈ {1, 2}, at a capacity tight
+    enough to force overflow drops — loss, outputs AND router/expert
+    gradients within 1e-5 (the values are bit-identical by construction:
+    only the wire schedule differs, pinned exact here)."""
+    mesh = _mesh()  # 8 devices → (outer, inner) = (4, 2)
+    n_experts = group * N_EXPERTS
+    router_w, per_expert, x = _setup(seed=20 + group, n_experts=n_experts)
+    stacked = shard_expert_params(stack_expert_params(per_expert), mesh)
+    capacity = 2  # 8 tokens/device: busy experts overflow
+    assert expected_dropped(router_w, x, capacity, top_k,
+                            n_shards=_shards(mesh, "alltoall")) > 0
+    tgt = jnp.tanh(jax.random.normal(jax.random.PRNGKey(21), (N_TOKENS, D)))
+
+    def loss_fn(rw, ps, impl):
+        out = moe_apply(rw, ps, x, mesh, _expert_fn, capacity, top_k=top_k,
+                        impl=impl)
+        return jnp.mean((out - tgt) ** 2), out
+
+    (l_f, o_f), g_f = jax.value_and_grad(
+        lambda rw, ps: loss_fn(rw, ps, "alltoall"),
+        argnums=(0, 1), has_aux=True)(router_w, stacked)
+    (l_2, o_2), g_2 = jax.value_and_grad(
+        lambda rw, ps: loss_fn(rw, ps, "alltoall_2d"),
+        argnums=(0, 1), has_aux=True)(router_w, stacked)
+    assert abs(float(l_f) - float(l_2)) <= 1e-5
+    assert jnp.array_equal(o_f, o_2)  # same routed values, bitwise
+    for a, b in zip(jax.tree_util.tree_leaves(g_f),
+                    jax.tree_util.tree_leaves(g_2)):
+        assert float(jnp.max(jnp.abs(jnp.asarray(a) - jnp.asarray(b)))) \
+            <= 1e-5
+
+
+def test_alltoall_2d_rejects_non_factorizable_axis():
+    """A prime (or < 4) expert-axis size has no (outer, inner) grid: the
+    seam rejects alltoall_2d LOUDLY at the call, through every selection
+    layer (factor helper, per-call impl, env override)."""
+    for bad in (2, 3, 5, 7):
+        with pytest.raises(ValueError, match="not factorizable"):
+            factor_expert_axis(bad)
+    assert factor_expert_axis(4) == (2, 2)
+    assert factor_expert_axis(8) == (4, 2)
+    router_w, per_expert, x = _setup(1)
+    mesh = _mesh(2)  # 2-device expert axis: prime
+    stacked = shard_expert_params(
+        stack_expert_params(per_expert[:2]), mesh)
+    with pytest.raises(ValueError, match="not factorizable"):
+        moe_apply(router_w[:, :2], stacked, x, mesh, _expert_fn, 8,
+                  impl="alltoall_2d")
+
+
+def test_moe_impl_seam_accepts_alltoall_2d(monkeypatch):
+    """The precedence chain carries the new impl: env var, setter, and
+    per-call all resolve "alltoall_2d"; its routing sub-shard semantics
+    match the flat alltoall (route_shards equal), and the dispatched
+    output matches the alltoall reference at drop-discriminating
+    capacity."""
+    router_w, per_expert, x = _setup(1)
+    mesh = _mesh()
+    stacked = shard_expert_params(stack_expert_params(per_expert), mesh)
+    capacity = 4
+
+    def run(**kw):
+        return moe_apply(router_w, stacked, x, mesh, _expert_fn, capacity,
+                         **kw)
+
+    ref = moe_reference(router_w, per_expert, x, _expert_fn, capacity,
+                        n_token_shards=_shards(mesh, "alltoall_2d"))
+    assert _shards(mesh, "alltoall_2d") == _shards(mesh, "alltoall")
+    # env override resolves the 2D impl
+    monkeypatch.setenv("DL4J_TPU_MOE_IMPL", "alltoall_2d")
+    assert resolve_moe_impl(N_TOKENS, 8) == "alltoall_2d"
+    assert jnp.allclose(run(), ref, atol=1e-5)
+    monkeypatch.delenv("DL4J_TPU_MOE_IMPL")
+    # setter
+    set_moe_impl("alltoall_2d")
+    try:
+        assert resolve_moe_impl(N_TOKENS, 8) == "alltoall_2d"
+        assert jnp.allclose(run(), ref, atol=1e-5)
+    finally:
+        set_moe_impl(None)
+    # per-call
+    assert jnp.allclose(run(impl="alltoall_2d"), ref, atol=1e-5)
+    # bogus env value still rejected loudly
+    monkeypatch.setenv("DL4J_TPU_MOE_IMPL", "alltoall_3d")
+    with pytest.raises(ValueError, match="alltoall_3d"):
+        resolve_moe_impl(N_TOKENS, 8)
+    monkeypatch.delenv("DL4J_TPU_MOE_IMPL")
+
+
+def test_alltoall_2d_step_retrace_budget(retrace_budget):
+    """A warmed jitted SGD step through the 2-phase dispatch holds the
+    same 0-compile steady budget as the flat exchange (ISSUE 14
+    acceptance: the factorization must not introduce per-step retraces)."""
+    router_w, per_expert, x = _setup(7)
+    mesh = _mesh()
+    params = shard_expert_params(stack_expert_params(per_expert), mesh)
+    tgt = jnp.tanh(jax.random.normal(jax.random.PRNGKey(13), (N_TOKENS, D)))
+    jax.block_until_ready(
+        moe_apply(router_w, params, x, mesh, _expert_fn, 16,
+                  impl="alltoall_2d"))  # collective warmup, see test_moe_trains
+
+    @jax.jit
+    def step(rw, ps):
+        def loss_fn(rw, ps):
+            out = moe_apply(rw, ps, x, mesh, _expert_fn, 16, top_k=2,
+                            impl="alltoall_2d")
+            return jnp.mean((out - tgt) ** 2)
+
+        loss, (gr, ge) = jax.value_and_grad(loss_fn, argnums=(0, 1))(rw, ps)
+        return rw - 0.5 * gr, jax.tree_util.tree_map(
+            lambda p, g: p - 0.5 * g, ps, ge), loss
+
+    for _ in range(2):  # compile + committed-sharding warmup
+        router_w, params, loss = step(router_w, params)
+        jax.block_until_ready(loss)
+    with retrace_budget(0, label="alltoall_2d moe step steady state"):
+        for _ in range(2):
+            router_w, params, loss = step(router_w, params)
+            jax.block_until_ready(loss)
+    assert np.isfinite(float(loss))
 
 
 def test_grouped_replicated_matches_dense():

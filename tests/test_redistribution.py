@@ -1,0 +1,290 @@
+"""In-graph redistribution plans (ISSUE 14; scaleout/ckpt/redistribution).
+
+Pins: plan derivation (the slice/all_gather/all_to_all/ppermute step
+kinds), plan execution parity vs the host-callback resharding loader
+across the existing cross-mesh matrix (dp×ep ↔ dp×sp×ep ↔ dp×pp carry ↔
+single-device), the compiled plan's collective inventory matching the
+planned step kinds, the randomized round-trip identity property, and the
+two live consumers (elastic param adoption, serve cold start)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import (
+    Mesh,
+    NamedSharding,
+    PartitionSpec as P,
+    SingleDeviceSharding,
+)
+
+from deeplearning4j_tpu.scaleout.ckpt.redistribution import (
+    PlanStep,
+    apply_plan,
+    plan_cross_mesh,
+    plan_redistribution,
+    redistribute,
+    redistribute_tree,
+)
+
+
+def _mesh_dp_ep():
+    return Mesh(np.array(jax.devices()).reshape(2, 4), ("data", "expert"))
+
+
+def _mesh_dp_sp_ep():
+    return Mesh(np.array(jax.devices()).reshape(2, 2, 2),
+                ("data", "sp", "expert"))
+
+
+def _mesh_dp_pp():
+    return Mesh(np.array(jax.devices()).reshape(2, 4), ("data", "pipe"))
+
+
+class TestPlanDerivation:
+    def test_noop_move_gather_slice_kinds(self):
+        mesh = _mesh_dp_ep()
+        assert plan_redistribution(P("data", "expert"), P("data", "expert"),
+                                   mesh).kinds() == []
+        assert plan_redistribution(P(None, "expert"), P("expert", None),
+                                   mesh).kinds() == ["all_to_all"]
+        assert plan_redistribution(P("data", "expert"), P(None, "expert"),
+                                   mesh).kinds() == ["all_gather"]
+        assert plan_redistribution(P(None, None), P("data", "expert"),
+                                   mesh).kinds() == ["slice"]
+
+    def test_compound_plan_orders_gather_move_slice(self):
+        mesh = _mesh_dp_ep()
+        # "expert" leaves dim 0 entirely, "data" moves 0 -> 1: gather
+        # then move, no trailing slice needed
+        plan = plan_redistribution(P(("data", "expert"), None),
+                                   P(None, "data"), mesh)
+        assert plan.kinds() == ["all_gather", "all_to_all"]
+        assert plan.steps[0].partition_spec() == P("data", None)
+        # gather + slice composition
+        plan2 = plan_redistribution(P("data", None), P(None, "expert"), mesh)
+        assert plan2.kinds() == ["all_gather", "slice"]
+
+    def test_unknown_axis_rejected(self):
+        with pytest.raises(ValueError, match="not on the mesh"):
+            plan_redistribution(P("bogus"), P(), _mesh_dp_ep())
+
+    def test_cross_mesh_plan_kinds(self):
+        a, b = _mesh_dp_ep(), _mesh_dp_sp_ep()
+        src = NamedSharding(a, P(None, "expert"))
+        # 4-way -> 2-way shard on dim 1: structure changes → all_to_all
+        assert plan_cross_mesh(
+            src, NamedSharding(b, P(None, "expert")), 2
+        ).kinds() == ["all_to_all"]
+        # same per-dim structure on a renamed mesh → pure device permute
+        assert plan_cross_mesh(
+            NamedSharding(a, P("data", None)),
+            NamedSharding(_mesh_dp_pp(), P("data", None)), 2
+        ).kinds() == ["ppermute"]
+
+
+class TestPlanExecution:
+    def _arr(self, mesh, spec, shape=(8, 8)):
+        x = jnp.arange(int(np.prod(shape)), dtype=jnp.float32).reshape(shape)
+        return x, jax.device_put(x, NamedSharding(mesh, spec))
+
+    def test_apply_plan_values_and_placement(self):
+        mesh = _mesh_dp_ep()
+        x, xa = self._arr(mesh, P(None, "expert"))
+        plan = plan_redistribution(P(None, "expert"), P("expert", None),
+                                   mesh)
+        y = apply_plan(plan, xa)
+        assert y.sharding == NamedSharding(mesh, P("expert", None))
+        assert jnp.array_equal(jax.device_get(y), x)
+
+    def test_compiled_plan_inventory_matches_step_kinds(self):
+        """The jitted plan's HLO contains exactly the planned collective
+        kinds: an all_to_all move shows all-to-all, a gather shows
+        all-gather, a slice program has NO comm at all."""
+        from deeplearning4j_tpu.telemetry.xprofile import profile_lowered
+
+        mesh = _mesh_dp_ep()
+        _, xa = self._arr(mesh, P(None, "expert"))
+
+        def inventory(src_spec, dst_spec, arr):
+            plan = plan_redistribution(src_spec, dst_spec, mesh)
+            dst = NamedSharding(mesh, plan.steps[-1].partition_spec())
+            prof = profile_lowered(
+                jax.jit(lambda v: v, out_shardings=dst).lower(arr),
+                label="plan")
+            return set(prof.collectives)
+
+        assert inventory(P(None, "expert"), P("expert", None),
+                         xa) == {"all-to-all"}
+        assert inventory(P(None, "expert"), P(), xa) == {"all-gather"}
+        _, xr = self._arr(mesh, P())
+        assert inventory(P(), P("data", "expert"), xr) == set()
+
+    @pytest.mark.parametrize("src_fn,dst_fn", [
+        (lambda: (_mesh_dp_ep(), P(None, "expert")),
+         lambda: (_mesh_dp_sp_ep(), P(None, "expert"))),
+        (lambda: (_mesh_dp_sp_ep(), P("data", "sp")),
+         lambda: (_mesh_dp_ep(), P("data", "expert"))),
+        (lambda: (_mesh_dp_pp(), P("pipe", None)),
+         lambda: (_mesh_dp_ep(), P(None, "expert"))),
+    ])
+    def test_cross_mesh_redistribute_values(self, src_fn, dst_fn):
+        src_mesh, src_spec = src_fn()
+        dst_mesh, dst_spec = dst_fn()
+        x = jnp.arange(64, dtype=jnp.float32).reshape(8, 8)
+        xa = jax.device_put(x, NamedSharding(src_mesh, src_spec))
+        y = redistribute(xa, NamedSharding(dst_mesh, dst_spec))
+        assert y.sharding == NamedSharding(dst_mesh, dst_spec)
+        assert jnp.array_equal(jax.device_get(y), x)
+
+
+class TestCrossMeshMatrixParityVsHostRestore:
+    """The acceptance pin: live in-graph redistribution of the flagship
+    params lands BIT-identical state to the host-callback resharding
+    loader (``restore_sharded``) restoring the same save, across the
+    existing cross-mesh matrix."""
+
+    def _params(self):
+        from deeplearning4j_tpu.models.transformer_lm import init_lm_params
+
+        return init_lm_params(jax.random.PRNGKey(0), vocab=32, d_model=16,
+                              n_heads=2, n_experts=4, d_ff=32, n_layers=2)
+
+    @pytest.mark.parametrize("src_fn,dst_fn", [
+        (_mesh_dp_ep, _mesh_dp_sp_ep),
+        (_mesh_dp_sp_ep, _mesh_dp_ep),
+        (_mesh_dp_ep, None),   # -> single device
+        (None, _mesh_dp_sp_ep),  # single device -> composed
+    ])
+    def test_live_matches_host_restore(self, tmp_path, src_fn, dst_fn):
+        from deeplearning4j_tpu.models.transformer_lm import (
+            lm_param_shardings,
+            shard_lm_params,
+        )
+        from deeplearning4j_tpu.scaleout.ckpt.reshard import restore_sharded
+        from deeplearning4j_tpu.scaleout.ckpt.sharded_io import save_sharded
+
+        params = self._params()
+        if src_fn is None:
+            dev = jax.devices()[0]
+            src = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, SingleDeviceSharding(dev)),
+                params)
+        else:
+            src = shard_lm_params(params, src_fn())
+        if dst_fn is None:
+            dev = jax.devices()[0]
+            dst_shardings = jax.tree_util.tree_map(
+                lambda _: SingleDeviceSharding(dev), params)
+        else:
+            dst_shardings = lm_param_shardings(params, dst_fn())
+
+        # host-callback oracle: save the SOURCE placement, restore onto dst
+        step_dir = save_sharded(str(tmp_path), 0, src)
+        # single-device targets restore unsharded through the host path
+        oracle_shardings = None if dst_fn is None else dst_shardings
+        oracle, _mf = restore_sharded(step_dir, params,
+                                      shardings=oracle_shardings)
+
+        live = redistribute_tree(src, dst_shardings)
+        for (pa, a), (pb, b) in zip(
+                jax.tree_util.tree_flatten_with_path(live)[0],
+                jax.tree_util.tree_flatten_with_path(oracle)[0]):
+            assert jax.tree_util.keystr(pa) == jax.tree_util.keystr(pb)
+            err = float(jnp.max(jnp.abs(jnp.asarray(a) - jnp.asarray(b))))
+            assert err <= 1e-6, (jax.tree_util.keystr(pa), err)
+
+    def test_live_lands_exact_dst_shardings(self):
+        from deeplearning4j_tpu.models.transformer_lm import (
+            lm_param_shardings,
+            shard_lm_params,
+        )
+
+        params = self._params()
+        src = shard_lm_params(params, _mesh_dp_ep())
+        dst_shardings = lm_param_shardings(params, _mesh_dp_sp_ep())
+        live = redistribute_tree(src, dst_shardings)
+        for leaf, sh in zip(jax.tree_util.tree_leaves(live),
+                            jax.tree_util.tree_leaves(dst_shardings)):
+            assert leaf.sharding == sh
+
+
+class TestRoundTripProperty:
+    def test_randomized_round_trip_identity(self):
+        """src→dst→src over randomized shardings is bitwise the identity
+        (the plan property test: every derived program is invertible and
+        lossless)."""
+        mesh = _mesh_dp_sp_ep()
+        axes = list(mesh.axis_names)
+        rng = np.random.default_rng(7)
+        x = jnp.asarray(rng.normal(size=(8, 8, 8)).astype(np.float32))
+
+        def random_spec():
+            remaining = list(axes)
+            rng.shuffle(remaining)
+            entries = []
+            for _ in range(3):
+                take = rng.integers(0, len(remaining) + 1)
+                picked = tuple(remaining[:take])
+                remaining = remaining[take:]
+                entries.append(picked if picked else None)
+            return P(*entries)
+
+        for trial in range(8):
+            src_spec, dst_spec = random_spec(), random_spec()
+            src_sh = NamedSharding(mesh, src_spec)
+            xa = jax.device_put(x, src_sh)
+            there = redistribute(xa, NamedSharding(mesh, dst_spec))
+            back = redistribute(there, src_sh)
+            assert back.sharding == src_sh, (trial, src_spec, dst_spec)
+            assert jnp.array_equal(jax.device_get(back), x), (
+                trial, src_spec, dst_spec)
+
+
+class TestLiveConsumers:
+    def test_elastic_run_steps_device_params_match_host_params(self):
+        """The elastic adoption fast path: run_steps fed the live
+        device-committed tree must land bitwise the same trajectory as
+        run_steps fed the same tree as host numpy."""
+        from deeplearning4j_tpu.scaleout.elastic import (
+            SyntheticRegressionModel,
+        )
+
+        model = SyntheticRegressionModel(d_in=8, d_hidden=16, batch=16,
+                                         mesh_devices=2)
+        p0 = model.init_params()
+        host = jax.tree_util.tree_map(np.asarray, p0)
+        p_host, l_host = model.run_steps(host, 0, 3, worker_seed=1)
+        # device-committed twin (the carried-tree case)
+        dev_tree = jax.tree_util.tree_map(jnp.asarray, host)
+        p_dev, l_dev = model.run_steps(dev_tree, 0, 3, worker_seed=1)
+        assert float(l_host) == float(l_dev)
+        for a, b in zip(jax.tree_util.tree_leaves(p_host),
+                        jax.tree_util.tree_leaves(p_dev)):
+            assert jnp.array_equal(jnp.asarray(a), jnp.asarray(b))
+
+    def test_engine_cold_start_from_live_sharded_params(self):
+        """Serve any-mesh cold start: an engine adopted from a LIVE dp×ep
+        sharded tree through the redistribution plans generates the same
+        tokens as one built from the identical host tree."""
+        from deeplearning4j_tpu.models.transformer_lm import (
+            init_lm_params,
+            shard_lm_params,
+        )
+        from deeplearning4j_tpu.serve.engine import DecodeEngine
+
+        params = init_lm_params(jax.random.PRNGKey(3), vocab=32, d_model=16,
+                                n_heads=2, n_experts=4, d_ff=32, n_layers=2)
+        sharded = shard_lm_params(params, _mesh_dp_ep())
+        kwargs = dict(n_slots=2, max_len=32, serve_dtype=None, seed=0)
+        live = DecodeEngine.from_live_params(sharded, 2, **kwargs)
+        host = DecodeEngine(params, 2, **kwargs)
+        assert live.weight_version == "live-params"
+        prompt = [1, 2, 3, 4]
+        out_live = live.generate(prompt, max_new_tokens=6)
+        out_host = host.generate(prompt, max_new_tokens=6)
+        assert out_live == out_host and len(out_live) == 6
+        # the adopted leaves really live on the serving device only
+        dev = jax.devices()[0]
+        for leaf in jax.tree_util.tree_leaves(live.params):
+            assert leaf.sharding.device_set == {dev}

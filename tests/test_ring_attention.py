@@ -71,6 +71,68 @@ def test_ring_grad_flows(mesh):
                                atol=5e-5, rtol=5e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("impl", ["dense", "blockwise"])
+def test_ring_prefetch_bit_identical_to_rotate_after(mesh, causal, impl):
+    """ISSUE 14: rotate-then-attend on the double buffer (prefetch=True,
+    the default) computes the IDENTICAL values as the historical
+    rotate-after-attend body — output AND gradients, both cores, both
+    mask modes. Only the trace order of the ppermute changes."""
+    q, k, v = _qkv(b=1, h=2, t=64, d=8, seed=6)
+
+    def run(prefetch):
+        return ring_attention(q, k, v, mesh, "sp", causal=causal,
+                              attn_impl=impl, prefetch=prefetch)
+
+    out_pf, out_ra = run(True), run(False)
+    assert jnp.array_equal(out_pf, out_ra)
+
+    g_pf = jax.grad(lambda q, k, v: ring_attention(
+        q, k, v, mesh, "sp", causal=causal, attn_impl=impl,
+        prefetch=True).sum())(q, k, v)
+    g_ra = jax.grad(lambda q, k, v: ring_attention(
+        q, k, v, mesh, "sp", causal=causal, attn_impl=impl,
+        prefetch=False).sum())(q, k, v)
+    assert jnp.array_equal(g_pf, g_ra)
+
+
+def test_composed_ring_prefetch_parity_dp_sp_ep():
+    """The composed dp×sp×ep flagship step with the prefetch ring vs the
+    rotate-after-attend oracle: loss AND updated params bit-identical
+    (the ring_prefetch seam threading through make_composed_train_step)."""
+    from deeplearning4j_tpu.models.transformer_lm import (
+        init_lm_params,
+        make_composed_train_step,
+        shard_lm_batch,
+        shard_lm_params,
+    )
+
+    cmesh = Mesh(np.array(jax.devices()).reshape(2, 2, 2),
+                 ("data", "sp", "expert"))
+    params = init_lm_params(jax.random.PRNGKey(0), vocab=32, d_model=16,
+                            n_heads=2, n_experts=4, d_ff=32, n_layers=1)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0, 32)
+    tk, tg = toks[:, :-1], toks[:, 1:]
+
+    def run(prefetch):
+        p = shard_lm_params(
+            jax.tree_util.tree_map(jnp.array, params), cmesh)
+        stoks, stgts = shard_lm_batch(tk, tg, cmesh)
+        step = make_composed_train_step(cmesh, 2, capacity=64,
+                                        moe_impl="alltoall",
+                                        ring_prefetch=prefetch)
+        for _ in range(2):
+            p, loss = step(p, stoks, stgts)
+        return p, loss
+
+    p_pf, l_pf = run(True)
+    p_ra, l_ra = run(False)
+    assert float(l_pf) == float(l_ra)
+    for a, b in zip(jax.tree_util.tree_leaves(p_pf),
+                    jax.tree_util.tree_leaves(p_ra)):
+        assert jnp.array_equal(a, b)
+
+
 def test_ulysses_matches_dense(mesh):
     q, k, v = _qkv(h=8, seed=4)  # 8 heads over 8 devices
     out = ulysses_attention(q, k, v, mesh, "sp", causal=False)

@@ -229,6 +229,87 @@ class TestProfileSeamPaths:
             jax.block_until_ready(loss)
         assert step.signature_fallbacks == 0
 
+    def test_dp_ep_alltoall_2d_factorized_inventory(self, retrace_budget):
+        """ISSUE 14 acceptance: on a dp×ep mesh whose expert axis
+        factorizes (ep=4 → 2×2), the ``alltoall_2d`` step's compiled HLO
+        replaces every flat all_to_all DEFINITION with two group-
+        factorized ones — twice the op count, every replica group of size
+        2 instead of 4, per-op wire bytes matching the analytic
+        (g−1)/g·B model and strictly below the flat op's — with loss AND
+        updated params within 1e-5 of the flat dispatch, at a 0-compile
+        steady retrace budget."""
+        from deeplearning4j_tpu.models.transformer_lm import (
+            init_lm_params,
+            make_composed_train_step,
+            shard_lm_batch,
+            shard_lm_params,
+        )
+
+        mesh = Mesh(np.array(jax.devices()).reshape(2, 4),
+                    ("data", "expert"))
+        n_experts = 4  # one per expert-axis device
+        base = init_lm_params(jax.random.PRNGKey(0), V, D, H, n_experts,
+                              DFF)
+        tk, tg = _lm_toks()
+
+        def run(moe_impl):
+            params = shard_lm_params(
+                jax.tree_util.tree_map(jnp.array, base), mesh)
+            stoks, stgts = shard_lm_batch(tk, tg, mesh)
+            step = make_composed_train_step(mesh, H, capacity=B * T,
+                                            moe_impl=moe_impl, profile=True)
+            params, loss = step(params, stoks, stgts)
+            return step, params, loss, stoks, stgts
+
+        step_f, p_f, l_f, _, _ = run("alltoall")
+        step_2, p_2, l_2, stoks, stgts = run("alltoall_2d")
+        prof_f = step_f.step_profile
+        prof_2 = step_2.step_profile
+
+        a2a_f = prof_f.collectives["all-to-all"]
+        a2a_2 = prof_2.collectives["all-to-all"]
+        assert a2a_f["group_sizes"] == [4]
+
+        ops_f = [o for o in prof_f.collective_ops
+                 if o["kind"] == "all-to-all"]
+        ops_2 = [o for o in prof_2.collective_ops
+                 if o["kind"] == "all-to-all"]
+        assert len(ops_f) == a2a_f["count"]  # nothing truncated
+        assert len(ops_2) == a2a_2["count"]
+        for op in ops_f + ops_2:
+            # the analytic ring model holds per definition: (g−1)/g·B
+            g, payload = op["group_size"], op["payload_bytes"]
+            assert op["wire_bytes"] == pytest.approx(
+                (g - 1) / g * payload, rel=1e-6), op
+        # GSPMD may insert flat-group respec a2a ops OUTSIDE the MoE
+        # dispatch (batch resharding); those appear unchanged in both
+        # programs. The MoE exchange ops are the remainder — and every
+        # one of them factorizes into TWO group-2 definitions.
+        respec = [o for o in ops_2 if o["group_size"] == 4]
+        factored = [o for o in ops_2 if o["group_size"] == 2]
+        assert factored and len(ops_2) == len(respec) + len(factored)
+        assert len(factored) == 2 * (len(ops_f) - len(respec)), (
+            ops_f, ops_2)
+        # per-collective reduction at the SAME per-op payload B: a
+        # factorized definition moves (1/2)·B vs the flat one's (3/4)·B
+        flat_payloads = {o["payload_bytes"] for o in ops_f}
+        for op in factored:
+            assert op["payload_bytes"] in flat_payloads, op
+            assert op["wire_bytes"] < (3 / 4) * op["payload_bytes"] - 1e-6
+
+        # parity vs the flat impl (bit-identical routing; ≤1e-5 pinned)
+        assert abs(float(l_f) - float(l_2)) <= 1e-5
+        for a, b in zip(jax.tree_util.tree_leaves(p_f),
+                        jax.tree_util.tree_leaves(p_2)):
+            assert float(jnp.max(jnp.abs(jnp.asarray(a) - jnp.asarray(b)))
+                         ) <= 1e-5
+
+        with retrace_budget(0, label="alltoall_2d dp×ep steady state"):
+            for _ in range(2):
+                p_2, l_2 = step_2(p_2, stoks, stgts)
+            jax.block_until_ready(l_2)
+        assert step_2.signature_fallbacks == 0
+
     def test_dp_ep_replicated_has_no_all_to_all(self):
         from deeplearning4j_tpu.models.transformer_lm import (
             init_lm_params,
@@ -664,3 +745,97 @@ class TestProfileReportTools:
         rounds = bench_report.load_rounds(str(tmp_path))
         traj = bench_report.build_trajectory(rounds, threshold_pct=10.0)
         assert traj["regressions"] == []
+
+    def _comm_overlap_round(self, tmp_path, n, wire, ratio=1.1):
+        """A round whose detail mimics the ISSUE 14 comm_overlap stage:
+        ratio rows at top level + the stage detail's tracked wire total."""
+        detail = {
+            "comm_overlap_overlap_vs_strict": ratio,
+            "comm_overlap_a2a_2d_vs_flat": ratio,
+            "comm_overlap_ring_prefetch_vs_rotate_after": ratio,
+            "comm_overlap_detail": {"collective_wire_bytes": wire,
+                                    "profile": self._blob(wire=wire)},
+        }
+        rec = {"n": n, "cmd": "bench", "rc": 0, "tail": "",
+               "parsed": {"metric": "m", "value": ratio, "detail": detail}}
+        (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps(rec))
+
+    def test_bench_report_tracks_comm_overlap_rows_and_wire_bytes(
+            self, tmp_path):
+        """ISSUE 14 satellite: the comm_overlap_* ratio rows are tracked
+        (HIGHER is better — a shrinking overlap ratio flags) and the
+        stage's collective_wire_bytes row is LOWER-IS-BETTER, pinned BOTH
+        directions: comm growth trips --fail-on-regression, shrink does
+        not."""
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import bench_report
+
+        # growth direction: wire balloons, ratios steady → regression
+        self._comm_overlap_round(tmp_path, 6, wire=1000.0)
+        self._comm_overlap_round(tmp_path, 7, wire=5000.0)
+        rounds = bench_report.load_rounds(str(tmp_path))
+        m = rounds[-1]["metrics"]
+        assert m["comm_overlap_collective_wire_bytes"] == 5000.0
+        assert m["comm_overlap_overlap_vs_strict"] == 1.1
+        assert m["comm_overlap_a2a_2d_vs_flat"] == 1.1
+        assert m["comm_overlap_ring_prefetch_vs_rotate_after"] == 1.1
+        traj = bench_report.build_trajectory(rounds, threshold_pct=10.0)
+        regressed = {r["metric"] for r in traj["regressions"]}
+        assert "comm_overlap_collective_wire_bytes" in regressed
+        rc = bench_report.main(["--dir", str(tmp_path),
+                                "--fail-on-regression"])
+        assert rc == 1
+
+        # shrink direction: wire drops (the factorization landing) → clean
+        for f in tmp_path.glob("BENCH_r*.json"):
+            f.unlink()
+        self._comm_overlap_round(tmp_path, 6, wire=5000.0)
+        self._comm_overlap_round(tmp_path, 7, wire=1000.0)
+        rounds = bench_report.load_rounds(str(tmp_path))
+        traj = bench_report.build_trajectory(rounds, threshold_pct=10.0)
+        assert traj["regressions"] == []
+        # ...but an eroding overlap ratio DOES flag (higher-is-better row)
+        for f in tmp_path.glob("BENCH_r*.json"):
+            f.unlink()
+        self._comm_overlap_round(tmp_path, 6, wire=1000.0, ratio=1.2)
+        self._comm_overlap_round(tmp_path, 7, wire=1000.0, ratio=0.8)
+        rounds = bench_report.load_rounds(str(tmp_path))
+        traj = bench_report.build_trajectory(rounds, threshold_pct=10.0)
+        regressed = {r["metric"] for r in traj["regressions"]}
+        assert "comm_overlap_overlap_vs_strict" in regressed
+
+    def test_profile_report_per_collective_delta_table(self, tmp_path,
+                                                       capsys):
+        """ISSUE 14 satellite: the per-collective cross-round delta table
+        — op kind × count × payload × wire per stage — renders the
+        factorization's shape change (one flat group-4 a2a becoming two
+        group-2 definitions) in both text and JSON."""
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import profile_report
+
+        flat = self._blob(wire=36.0)
+        flat["collectives"] = {"all-to-all": {
+            "count": 1, "payload_bytes": 48, "wire_bytes": 36.0,
+            "group_sizes": [4]}}
+        factored = self._blob(wire=48.0)
+        factored["collectives"] = {"all-to-all": {
+            "count": 2, "payload_bytes": 96, "wire_bytes": 48.0,
+            "group_sizes": [2]}}
+        _write_round(tmp_path, 8, flat)
+        _write_round(tmp_path, 9, factored)
+
+        rc = profile_report.main(["--dir", str(tmp_path), "--json"])
+        rep = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        (row,) = [r for r in rep["collective_deltas"]
+                  if r["kind"] == "all-to-all"]
+        assert row["count"] == {"prev": 1, "last": 2, "delta_pct": 100.0}
+        assert row["payload_bytes"]["last"] == 96
+        assert row["wire_bytes"]["prev"] == 36.0
+        assert row["group_sizes"] == {"prev": [4], "last": [2]}
+
+        rc = profile_report.main(["--dir", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "per-collective deltas" in out
+        assert "all-to-all" in out and "1->2" in out

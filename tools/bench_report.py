@@ -34,6 +34,13 @@ the open-loop traffic generator) contribute
 ``<stage>_latency_{p50,p95,p99,mean}_ms`` rows, also LOWER-IS-BETTER —
 serving-latency growth past the threshold trips ``--fail-on-regression``
 exactly like a throughput drop.
+
+ISSUE 14: the ``comm_overlap_*`` step-time ratio rows (overlap_vs_strict,
+2d_vs_flat, prefetch_vs_rotate_after — higher is better) track the
+comm/compute-overlap A/Bs, and a stage detail's top-level
+``collective_wire_bytes`` contributes the LOWER-IS-BETTER
+``<stage>_collective_wire_bytes`` row so a PR growing the compiled step's
+comm volume trips the regression gate both directions.
 """
 
 from __future__ import annotations
@@ -50,15 +57,20 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # stage metrics worth tracking round over round: rates, MFU, A/B ratios
 # (peak_bytes_ratio: ISSUE 13's replicated/sharded optimizer footprint
-# headline — HIGHER is better, a shrinking ratio means the ZeRO win eroded)
+# headline — HIGHER is better, a shrinking ratio means the ZeRO win
+# eroded; the ISSUE 14 comm_overlap_* rows are the overlap/factorization
+# step-time ratios — overlap_vs_strict, 2d_vs_flat,
+# prefetch_vs_rotate_after — also higher-is-better)
 _METRIC_RE = re.compile(
     r"_(?:per_sec|per_chip|mfu|vs_cpu|vs_single|vs_densecore|vs_baseline|"
-    r"blocking_vs_background|overhead_pct|peak_bytes_ratio)$")
+    r"blocking_vs_background|overhead_pct|peak_bytes_ratio|"
+    r"overlap_vs_strict|2d_vs_flat|prefetch_vs_rotate_after)$")
 # metrics where an INCREASE is the regression (ISSUE 9 footprint rows,
-# ISSUE 10 serving-latency rows)
+# ISSUE 10 serving-latency rows, ISSUE 14 stage wire-byte rows)
 _LOWER_IS_BETTER_RE = re.compile(
     r"_profile_(?:peak_bytes|collective_bytes)$"
-    r"|_latency_(?:p50|p95|p99|mean)_ms$")
+    r"|_latency_(?:p50|p95|p99|mean)_ms$"
+    r"|_collective_wire_bytes$")
 # recovery regex for a truncated tail: top-level "key": number pairs
 _TAIL_PAIR_RE = re.compile(
     r'"([a-z0-9_]+)":\s*(-?[0-9]+(?:\.[0-9]+)?(?:[eE][-+]?[0-9]+)?)')
@@ -97,6 +109,24 @@ def _profile_metrics(detail: Dict) -> Dict[str, float]:
             v = prof.get(src)
             if isinstance(v, (int, float)):
                 out[f"{stage}_{metric}"] = float(v)
+    return out
+
+
+def _wire_metrics(detail: Dict) -> Dict[str, float]:
+    """Stage-level collective wire bytes (ISSUE 14): a stage detail
+    carrying a top-level ``collective_wire_bytes`` number (the
+    comm_overlap stage's tracked 2D-dispatch wire total) contributes the
+    ``<stage>_collective_wire_bytes`` row — LOWER-IS-BETTER, so comm
+    growth past the threshold trips ``--fail-on-regression`` exactly like
+    a footprint regression."""
+    out: Dict[str, float] = {}
+    for key, val in detail.items():
+        if not key.endswith("_detail") or not isinstance(val, dict):
+            continue
+        wire = val.get("collective_wire_bytes")
+        if isinstance(wire, (int, float)):
+            stage = key[: -len("_detail")]
+            out[f"{stage}_collective_wire_bytes"] = float(wire)
     return out
 
 
@@ -146,6 +176,7 @@ def load_rounds(bench_dir: str) -> List[Dict]:
                        if _is_metric_key(k) and isinstance(v, (int, float))}
             metrics.update(_profile_metrics(detail))
             metrics.update(_latency_metrics(detail))
+            metrics.update(_wire_metrics(detail))
             rounds.append({"round": int(m.group(1)), "source": "parsed",
                            "metrics": metrics,
                            "headline": parsed.get("value")})

@@ -406,7 +406,8 @@ def env_read(ctx: ModuleContext) -> Iterable[Finding]:
     """os.environ/os.getenv reads outside the blessed seams (compat.py, or
     keys under the documented ``DL4J_TPU_*`` namespace — currently
     ``DL4J_TPU_ATTN_IMPL`` (ops/flash_attention.py attention-core chain),
-    ``DL4J_TPU_MOE_IMPL`` (parallel/moe.py dispatch chain), and
+    ``DL4J_TPU_MOE_IMPL`` (parallel/moe.py dispatch chain:
+    alltoall | alltoall_2d | replicated), and
     ``DL4J_TPU_UPDATE_SHARDING`` (optimize/updaters.py ZeRO
     update-sharding chain), all read host-side at trace/resolve time,
     never inside a traced body). Ad-hoc env reads are invisible config:

@@ -21,7 +21,12 @@ tool renders, for the selected round (default: latest with blobs):
   honesty signal — tier-1 pins the same ratio at test shapes);
 - **cross-round deltas** of FLOPs / peak bytes / collective wire bytes
   per stage — the cheap way to see a PR quietly fattening the compiled
-  step before it ever runs on a chip.
+  step before it ever runs on a chip;
+- a **per-collective delta table** (ISSUE 14: op kind × count × payload
+  bytes × wire bytes × replica-group sizes, prev round → last) so a
+  factorization's per-op shape change — one flat all-to-all becoming two
+  smaller-group definitions — shows up in the trajectory, not just the
+  aggregate wire total.
 
 Exit code 0 with "no profile blobs" when the rounds predate ISSUE 9 —
 missing data is reported, never invented.
@@ -141,6 +146,7 @@ def build_report(rounds: List[Dict],
 
     tracked = ("flops", "peak_bytes", "collective_wire_bytes")
     deltas = []
+    collective_deltas = []
     for stage in sorted(sel["stages"]):
         series = [(r["round"], r["stages"][stage]["profile"])
                   for r in rounds if stage in r["stages"]]
@@ -156,11 +162,32 @@ def build_report(rounds: List[Dict],
                               if a and b is not None else None),
             }
         deltas.append(row)
+        # ISSUE 14: per-collective (op kind × payload × wire) deltas so a
+        # factorization's per-op shape change — e.g. one flat all-to-all
+        # becoming two smaller-group definitions — is visible in the
+        # trajectory, not just the aggregate wire total
+        prev_c = prev.get("collectives") or {}
+        last_c = last.get("collectives") or {}
+        for kind in sorted(set(prev_c) | set(last_c)):
+            a, b = prev_c.get(kind) or {}, last_c.get(kind) or {}
+            crow = {"stage": stage, "kind": kind,
+                    "from_round": prev_n, "to_round": last_n,
+                    "group_sizes": {"prev": a.get("group_sizes"),
+                                    "last": b.get("group_sizes")}}
+            for key in ("count", "payload_bytes", "wire_bytes"):
+                va, vb = a.get(key), b.get(key)
+                crow[key] = {
+                    "prev": va, "last": vb,
+                    "delta_pct": (round((vb - va) / abs(va) * 100.0, 2)
+                                  if va and vb is not None else None),
+                }
+            collective_deltas.append(crow)
     return {
         "rounds": [r["round"] for r in rounds],
         "selected": sel["round"],
         "stages": stages,
         "deltas": deltas,
+        "collective_deltas": collective_deltas,
     }
 
 
@@ -214,6 +241,28 @@ def render_text(report: Dict) -> str:
                     f"  {row['stage']} {key}: {fmt(d['prev'])} -> "
                     f"{fmt(d['last'])} ({d['delta_pct']:+.1f}% "
                     f"r{row['from_round']}->r{row['to_round']}){flag}")
+    if report.get("collective_deltas"):
+        lines += ["", "per-collective deltas (op kind × payload × wire, "
+                  "prev -> last):"]
+        lines.append(f"  {'stage':<16} {'kind':<19} {'count':>11} "
+                     f"{'payload':>19} {'wire':>19}  groups")
+        for row in report["collective_deltas"]:
+            def cell(key, fmt):
+                d = row[key]
+                if d["prev"] is None and d["last"] is None:
+                    return "-"
+                a = fmt(d["prev"]) if d["prev"] is not None else "-"
+                b = fmt(d["last"]) if d["last"] is not None else "-"
+                return f"{a}->{b}"
+
+            groups = row["group_sizes"]
+            ga = groups["prev"] if groups["prev"] is not None else "-"
+            gb = groups["last"] if groups["last"] is not None else "-"
+            lines.append(
+                f"  {row['stage']:<16} {row['kind']:<19} "
+                f"{cell('count', lambda v: str(int(v))):>11} "
+                f"{cell('payload_bytes', _fmt_bytes):>19} "
+                f"{cell('wire_bytes', _fmt_bytes):>19}  {ga}->{gb}")
     return "\n".join(lines)
 
 
