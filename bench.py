@@ -1921,11 +1921,13 @@ def measure_serve() -> float:
         slots, max_len, max_new, n_req, rate = 4, 64, 8, 12, 400.0
         prompt_lo, prompt_hi = 4, 12
         naive_req = 4
+        slo_ms = 25.0
     else:
         vocab, d, heads, experts, dff, layers = LMC_VOCAB, 256, 4, 4, 512, 2
         slots, max_len, max_new, n_req, rate = 8, 256, 32, 32, 50.0
         prompt_lo, prompt_hi = 16, 48
         naive_req = 8
+        slo_ms = 250.0
 
     params = init_lm_params(jax.random.PRNGKey(0), vocab, d, heads, experts,
                             dff, n_layers=layers)
@@ -1978,7 +1980,7 @@ def measure_serve() -> float:
                           serve_dtype="bf16")
     warm(engine)
     report = run_open_loop(engine, prompts, rate_rps=rate,
-                           max_new_tokens=max_new)
+                           max_new_tokens=max_new, slo_ms=slo_ms)
     stats = engine.stats()
 
     # ---- int8 weight-only A/B twin ----
@@ -2065,6 +2067,14 @@ def measure_serve() -> float:
                 if report.first_token_p99_ms is not None else None),
         },
         "completed": report.completed,
+        # goodput under SLO (ISSUE 15 satellite): requests completing
+        # WITHIN slo_ms per second — the HIGHER-IS-BETTER bench_report
+        # row (serve_goodput_rps) ROADMAP 2's fleet bench will gate on
+        "goodput": {
+            "slo_ms": slo_ms,
+            "goodput_rps": round(report.goodput_rps, 3),
+            "slo_attainment": round(report.slo_attainment, 4),
+        },
         "naive_tokens_per_sec": round(naive_rate, 1),
         "naive_requests": naive_req,
         "serve_vs_naive": round(report.tokens_per_sec / naive_rate, 2),
@@ -2101,6 +2111,153 @@ def measure_serve() -> float:
     }
     print("STAGE_DETAIL " + json.dumps(detail), flush=True)
     return report.tokens_per_sec
+
+
+def measure_observability() -> float:
+    """ISSUE 15 watchtower bench: the SAME open-loop decode-engine run
+    twice — unarmed vs with the full watch layer armed (a MetricsHistory
+    sampler snapshotting the engine registry on a tight cadence plus an
+    AlertEngine evaluating the default rule pack over it, both on
+    background threads) — so the headline isolates what *being watched*
+    costs the serving hot path.
+
+    Headline value = overhead_pct (armed vs unarmed tokens/s; <5%
+    budget asserted in test_bench_smoke with the shared noise retry).
+    The detail also proves the chain end to end: the armed run's history
+    answers live rate/percentile queries, a deterministic injected-fault
+    demo drives nonfinite_step_rate and serve_latency_slo_burn through
+    pending→firing with transitions logged, and the alert/history JSONL
+    artifacts render through the REAL tools/alert_report.py."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.models.transformer_lm import init_lm_params
+    from deeplearning4j_tpu.serve import DecodeEngine, run_open_loop
+    from deeplearning4j_tpu.telemetry.alerts import (
+        AlertEngine,
+        AlertRule,
+        default_rules,
+    )
+    from deeplearning4j_tpu.telemetry.history import MetricsHistory
+    from deeplearning4j_tpu.telemetry.registry import MetricsRegistry
+
+    if _fast():
+        vocab, d, heads, experts, dff, layers = 128, 32, 2, 2, 64, 2
+        slots, max_len, max_new, n_req, rate = 4, 64, 8, 12, 400.0
+        prompt_lo, prompt_hi = 4, 12
+    else:
+        vocab, d, heads, experts, dff, layers = LMC_VOCAB, 256, 4, 4, 512, 2
+        slots, max_len, max_new, n_req, rate = 8, 256, 32, 32, 50.0
+        prompt_lo, prompt_hi = 16, 48
+
+    params = init_lm_params(jax.random.PRNGKey(0), vocab, d, heads, experts,
+                            dff, n_layers=layers)
+    rng = np.random.RandomState(7)
+    prompts = [list(rng.randint(0, vocab,
+                                rng.randint(prompt_lo, prompt_hi)))
+               for _ in range(n_req)]
+
+    def warm(eng):
+        for b in sorted({eng.bucket_for(len(p)) for p in prompts}):
+            eng.generate([1] * min(b, max_len - 1), max_new_tokens=2)
+
+    # ---- unarmed baseline ----
+    reg_base = MetricsRegistry()
+    engine = DecodeEngine(params, heads, n_slots=slots, max_len=max_len,
+                          serve_dtype="bf16", registry=reg_base)
+    warm(engine)
+    report = run_open_loop(engine, prompts, rate_rps=rate,
+                           max_new_tokens=max_new)
+
+    # ---- armed twin: history sampler + alert evaluator on background
+    # threads, sampling/evaluating at a cadence far above production
+    # (20Hz/10Hz vs the 1Hz default) so the measured overhead brackets
+    # any real deployment ----
+    watch_dir = tempfile.mkdtemp(prefix="bench_observability_")
+    reg_w = MetricsRegistry()
+    engine_w = DecodeEngine(params, heads, n_slots=slots, max_len=max_len,
+                            serve_dtype="bf16", registry=reg_w)
+    warm(engine_w)
+    history = MetricsHistory(
+        registry=reg_w, interval_s=0.05,
+        spill_path=os.path.join(watch_dir, "history_serve.jsonl"))
+    alert_engine = AlertEngine(
+        history, rules=default_rules(), registry=reg_w, process="serve",
+        interval_s=0.1,
+        log_path=os.path.join(watch_dir, "alerts_serve.jsonl"))
+    history.start()
+    alert_engine.start()
+    try:
+        report_w = run_open_loop(engine_w, prompts, rate_rps=rate,
+                                 max_new_tokens=max_new)
+        history.sample_once()  # deterministic final edge for the queries
+        states_armed = alert_engine.evaluate_once()
+    finally:
+        alert_engine.close()
+        history.close()
+    overhead_pct = round(
+        (1.0 - report_w.tokens_per_sec / report.tokens_per_sec) * 100.0, 2)
+
+    # live-query proof off the armed run's real history
+    token_rate = history.rate("serve_tokens_total", window_s=300.0)
+    p95_windowed = history.percentile_over("serve_request_ms", 95.0,
+                                           window_s=300.0)
+    quiet = {s["rule"]: s["state"] for s in states_armed}
+
+    # ---- deterministic firing demo: inject the faults the pack watches
+    # (guard skips + SLO-busting latencies) into the SAME registry and
+    # tick the watch layer — pending→firing transitions land in the log
+    # and the alert_report renders them ----
+    reg_w.counter("guard_skipped_steps_total").inc(0)
+    history.sample_once()
+    reg_w.counter("guard_skipped_steps_total").inc(5)
+    for _ in range(60):
+        reg_w.histogram("serve_request_ms").observe(2600.0)
+    time.sleep(0.05)  # a strictly later sample timestamp for the window
+    history.sample_once()
+    demo_rules = [r for r in default_rules()
+                  if r.name in ("nonfinite_step_rate",
+                                "serve_latency_slo_burn")]
+    demo_engine = AlertEngine(
+        history, rules=demo_rules, registry=reg_w, process="serve-demo",
+        log_path=os.path.join(watch_dir, "alerts_serve-demo.jsonl"))
+    demo_states = {s["rule"]: s["state"]
+                   for s in demo_engine.evaluate_once()}
+    demo_engine.close()
+
+    from tools.alert_report import collect as alert_collect
+
+    art = alert_collect(watch_dir)
+    fired = [t for t in art["transitions"] if t["to"] == "firing"]
+
+    detail = {
+        "slots": slots, "max_len": max_len, "n_requests": n_req,
+        "offered_rps": rate,
+        "tokens_per_sec": round(report.tokens_per_sec, 1),
+        "tokens_per_sec_watched": round(report_w.tokens_per_sec, 1),
+        "overhead_pct": overhead_pct,
+        "history": {
+            "samples": int(reg_w.counter("history_samples_total").value),
+            "series": int(reg_w.gauge("history_series").value),
+            "serve_tokens_rate_per_s": (round(token_rate, 1)
+                                        if token_rate is not None
+                                        else None),
+            "serve_request_p95_windowed_ms": p95_windowed,
+        },
+        "alerts": {
+            "rules": len(default_rules()),
+            "quiet_run_firing": sorted(r for r, st in quiet.items()
+                                       if st == "firing"),
+            "demo_states": demo_states,
+            "report_transitions": len(art["transitions"]),
+            "report_fired": sorted({t["rule"] for t in fired}),
+        },
+    }
+    print("STAGE_DETAIL " + json.dumps(detail), flush=True)
+    return overhead_pct
+
 
 
 # ---------------------------------------------------------------------------
@@ -2209,6 +2366,8 @@ def run_stage(name: str) -> float:
         return measure_comm_overlap()
     if name == "serve":
         return measure_serve()
+    if name == "observability":
+        return measure_observability()
     if name == "word2vec":
         if _fast():
             return measure_word2vec(n_sentences=100, sent_len=20, vocab=200)
@@ -2308,6 +2467,7 @@ STAGES = [
     ("moe", 220),
     ("comm_overlap", 240),
     ("serve", 240),
+    ("observability", 240),
     ("cpu_word2vec", 150),
     ("word2vec", 120),
     ("word2vec_sharded", 150),
@@ -2380,7 +2540,8 @@ def main() -> None:
             key = f"{stage}_blocking_vs_background"
         elif stage == "elastic_sync":
             key = f"{stage}_steps_per_sec"
-        elif stage in ("elastic_trace", "guardrails", "profile"):
+        elif stage in ("elastic_trace", "guardrails", "profile",
+                       "observability"):
             key = f"{stage}_overhead_pct"
         elif stage == "optimizer":
             # replicated/sharded compiled peak-bytes ratio: >1 means the

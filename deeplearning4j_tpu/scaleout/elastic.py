@@ -430,8 +430,20 @@ class SyntheticRegressionModel(ElasticModel):
                     params, loss = out
         host = jax.tree_util.tree_map(np.asarray, jax.device_get(params))
         if nonfinite_flags:
-            self.skipped_steps += int(sum(
+            skipped = int(sum(
                 float(v) for v in jax.device_get(nonfinite_flags)))
+            self.skipped_steps += skipped
+            if skipped:
+                # the live wiring for the nonfinite_step_rate alert rule
+                # (ISSUE 15): guarded skips surface in the process
+                # registry a worker-side watchtower samples — same name
+                # the DivergenceWatchdog uses on the trainer paths
+                from deeplearning4j_tpu.telemetry.registry import (
+                    default_registry,
+                )
+
+                default_registry().counter(
+                    "guard_skipped_steps_total").inc(skipped)
         return host, (float(loss) if loss is not None else float("nan"))
 
 
@@ -746,7 +758,8 @@ class ElasticMaster:
                  round_timeout_s: float = 120.0, tick_s: float = 0.01,
                  checkpointer=None, checkpoint_every: int = 0,
                  registry=None, trace_dir: Optional[str] = None,
-                 quarantine_nonfinite: bool = True):
+                 quarantine_nonfinite: bool = True,
+                 watch: bool = False, watch_dir: Optional[str] = None):
         from deeplearning4j_tpu.telemetry.registry import default_registry
 
         # tracing: adopt the process tracer if one is configured; a
@@ -784,6 +797,19 @@ class ElasticMaster:
         # averaging can NEVER ingest a poisoned delta
         self.quarantine_nonfinite = bool(quarantine_nonfinite)
         self._quarantined: set = set()
+        # watchtower (ISSUE 15): history sampler + alert engine over THIS
+        # master's registry, publishing verdicts into the embedded
+        # tracker's KV — workers / routers / a UiServer aggregator read
+        # the cluster alert view over the same TCP plane the membership
+        # rides. The default pack's worker_divergence / heartbeat-stale /
+        # reconnect-storm rules all key off metrics this class emits.
+        self.watchtower = None
+        if watch:
+            from deeplearning4j_tpu.telemetry.alerts import arm_watchtower
+
+            self.watchtower = arm_watchtower(
+                registry=self.registry, tracker=self.tracker,
+                process="master", out_dir=watch_dir)
         self._publish_version(self.version, self._params)
 
     # -- plumbing --
@@ -828,6 +854,11 @@ class ElasticMaster:
             seen = self._hb_seen.get(wid)
             if seen is None or seen[0] != count:
                 self._hb_seen[wid] = (count, now)
+                # heartbeat-timestamp gauge (ISSUE 15): the absence-rule
+                # convention — a *_unix gauge per worker that the
+                # worker_heartbeat_stale rule checks for staleness
+                self.registry.gauge("elastic_worker_heartbeat_unix",
+                                    {"worker": wid}).set(time.time())
             elif now - seen[1] > self.worker_timeout_s:
                 dead.append(wid)
         return dead
@@ -835,6 +866,10 @@ class ElasticMaster:
     def _bury(self, wid: str) -> None:
         self.tracker.remove_worker(wid)
         self._hb_seen.pop(wid, None)
+        # retire the heartbeat series (non-positive sentinel): a BURIED
+        # worker is handled — the staleness alert must stop firing for it
+        self.registry.gauge("elastic_worker_heartbeat_unix",
+                            {"worker": wid}).set(-1.0)
         self.tracker.increment("workers_failed")
         self.registry.counter("elastic_workers_failed_total").inc()
         log.warning("elastic worker %s heartbeat stale >%ss: deregistered; "
@@ -883,6 +918,8 @@ class ElasticMaster:
         self._quarantined.add(wid)
         self.tracker.remove_worker(wid)
         self._hb_seen.pop(wid, None)
+        self.registry.gauge("elastic_worker_heartbeat_unix",
+                            {"worker": wid}).set(-1.0)
         self.tracker.increment("workers_quarantined")
         self.registry.counter("elastic_workers_quarantined_total").inc()
         log.error("elastic worker %s published a NON-FINITE contribution "
@@ -1057,6 +1094,10 @@ class ElasticMaster:
         return self._params
 
     def shutdown(self) -> None:
+        if self.watchtower is not None:
+            self.watchtower.tick()  # final verdict lands even mid-interval
+            self.watchtower.stop()
+            self.watchtower = None
         if self.checkpointer is not None and hasattr(self.checkpointer,
                                                      "flush"):
             self.checkpointer.flush()
@@ -1145,6 +1186,12 @@ def worker_main(argv=None) -> None:
     p.add_argument("--trace-dir", default=None,
                    help="write per-process span JSONL + flight-recorder "
                         "dumps under this directory (ISSUE 7)")
+    p.add_argument("--watch-dir", default=None,
+                   help="arm the watchtower (ISSUE 15): sample this "
+                        "process's registry into a history spill, "
+                        "evaluate the default alert pack, publish "
+                        "verdicts to the master's tracker KV, and write "
+                        "history/alert JSONL under this directory")
     args = p.parse_args(argv)
     model = _resolve_model(args.model, json.loads(args.kwargs_json))
     worker = ElasticWorker(
@@ -1155,7 +1202,21 @@ def worker_main(argv=None) -> None:
         crash_after_steps=args.crash_after_steps)
     if args.trace_dir:
         _trace.configure(worker.worker_id, args.trace_dir)
-    summary = worker.run()
+    tower = None
+    if args.watch_dir:
+        from deeplearning4j_tpu.telemetry.alerts import arm_watchtower
+
+        # its own tracker connection: alert publishes must never ride
+        # (or stall behind) the training loop's RPC slot
+        tower = arm_watchtower(process=worker.worker_id,
+                               tracker_address=args.connect,
+                               out_dir=args.watch_dir)
+    try:
+        summary = worker.run()
+    finally:
+        if tower is not None:
+            tower.tick()  # the final verdict lands even mid-interval
+            tower.stop()
     print("ELASTIC_WORKER_DONE " + json.dumps(summary), flush=True)
 
 

@@ -111,6 +111,10 @@ class ServeRequest:
         self.span = None          # serve.request (submit → retire)
         self.queue_span = None    # serve.queue_wait (submit → admission)
         self.decode_span = None   # serve.decode (admission → retire)
+        # the request's trace id outlives the span (ISSUE 15): latency
+        # histogram observations attach it as an exemplar at retire time,
+        # after serve.request has already ended
+        self.trace_id = None
         self.prefill_ms: float = 0.0
         self.decode_ms: float = 0.0  # sum of decode dispatches it rode
 
@@ -315,6 +319,7 @@ class DecodeEngine:
                        "weight_version": self.weight_version})
             req.queue_span = tracer.start_span("serve.queue_wait",
                                                parent=req.span)
+            req.trace_id = req.span.trace_id
         with self._work:
             self._queue.append(req)
             self.requests_total += 1
@@ -353,7 +358,7 @@ class DecodeEngine:
         if prefill_span is not None:
             prefill_span.end()
         self.registry.histogram("serve_prefill_ms").observe(
-            (now - t0) * 1000.0)
+            (now - t0) * 1000.0, exemplar=req.trace_id)
         req.slot = slot
         req.t_first = now
         self._slots[slot] = req
@@ -425,11 +430,16 @@ class DecodeEngine:
             req.slot = None
         self.registry.counter("serve_completed_total",
                               {"reason": reason}).inc()
+        # trace exemplars (ISSUE 15): the request's trace id rides its
+        # latency observation into the bucket, so /metrics (OpenMetrics
+        # exemplar syntax) and a firing serve_latency_slo_burn alert can
+        # name the exact offending traces (None when tracing is off)
         self.registry.histogram("serve_request_ms").observe(
-            (now - req.t_submit) * 1000.0)
+            (now - req.t_submit) * 1000.0, exemplar=req.trace_id)
         if req.t_first is not None:
             self.registry.histogram("serve_first_token_ms").observe(
-                (req.t_first - req.t_submit) * 1000.0)
+                (req.t_first - req.t_submit) * 1000.0,
+                exemplar=req.trace_id)
         req.done.set()
 
     # ------------------------------------------------------------- stepping ----

@@ -62,9 +62,29 @@ class LoadReport:
     latency_p99_ms: float = 0.0
     first_token_p50_ms: Optional[float] = None
     first_token_p99_ms: Optional[float] = None
+    # goodput under SLO (ISSUE 15 satellite): with ``slo_ms`` set, the
+    # run also reports how many requests completed WITHIN the objective
+    # per second — the higher-is-better number a fleet bench gates on
+    # (raw throughput can grow while the SLO-violating tail grows faster;
+    # goodput can't be gamed that way)
+    slo_ms: Optional[float] = None
+    goodput_rps: Optional[float] = None
+    slo_attainment: Optional[float] = None  # fraction within SLO
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
+
+
+def _goodput(lat_ms: List[float], slo_ms: Optional[float],
+             duration_s: float) -> tuple:
+    """(goodput_rps, slo_attainment) over completed-request latencies —
+    a request counts toward goodput only when its latency (from the
+    SCHEDULED arrival, queueing included) is <= slo_ms."""
+    if slo_ms is None:
+        return None, None
+    good = sum(1 for v in lat_ms if v <= slo_ms)
+    return (good / duration_s if duration_s > 0 else 0.0,
+            good / len(lat_ms) if lat_ms else 0.0)
 
 
 def arrival_schedule(n: int, rate_rps: float, seed: int = 0) -> List[float]:
@@ -91,7 +111,8 @@ def _percentiles(values_ms: List[float]) -> tuple:
 def run_open_loop(engine, prompts: Sequence[Sequence[int]],
                   rate_rps: float, max_new_tokens: int = 16,
                   temperature: float = 0.0, seed: int = 0,
-                  timeout_s: float = 300.0) -> LoadReport:
+                  timeout_s: float = 300.0,
+                  slo_ms: Optional[float] = None) -> LoadReport:
     """Drive ``engine`` with open-loop arrivals of ``prompts`` (one
     request each, in order) at ``rate_rps``. The engine must NOT be
     running its background loop — this driver owns the step cadence so the
@@ -131,6 +152,7 @@ def run_open_loop(engine, prompts: Sequence[Sequence[int]],
     p50, p95, p99, mean = _percentiles(lat)
     ft = _percentiles(first) if first else None
     duration = t_end - t0
+    goodput_rps, attainment = _goodput(lat, slo_ms, duration)
     return LoadReport(
         n_requests=len(prompts), completed=done, duration_s=duration,
         tokens_out=tokens,
@@ -138,13 +160,16 @@ def run_open_loop(engine, prompts: Sequence[Sequence[int]],
         offered_rps=rate_rps, latency_p50_ms=p50, latency_p95_ms=p95,
         latency_p99_ms=p99, latency_mean_ms=mean,
         first_token_p50_ms=ft[0] if ft else None,
-        first_token_p99_ms=ft[2] if ft else None)
+        first_token_p99_ms=ft[2] if ft else None,
+        slo_ms=slo_ms, goodput_rps=goodput_rps,
+        slo_attainment=attainment)
 
 
 def run_open_loop_http(base_url: str, prompts: Sequence[Sequence[int]],
                        rate_rps: float, max_new_tokens: int = 16,
                        temperature: float = 0.0, seed: int = 0,
-                       timeout_s: float = 120.0) -> LoadReport:
+                       timeout_s: float = 120.0,
+                       slo_ms: Optional[float] = None) -> LoadReport:
     """Open-loop arrivals POSTed to ``<base_url>/api/generate`` (the
     UiServer front-end; the server-side engine must be ``start()``ed).
     One thread per request fires at its scheduled arrival."""
@@ -189,12 +214,15 @@ def run_open_loop_http(base_url: str, prompts: Sequence[Sequence[int]],
     t_end = time.perf_counter()
     done = [i for i, r in enumerate(results) if r is not None]
     tokens = sum(len(results[i].get("tokens", [])) for i in done)
-    p50, p95, p99, mean = _percentiles([lat_ms[i] for i in done
-                                        if lat_ms[i] is not None])
+    lat = [lat_ms[i] for i in done if lat_ms[i] is not None]
+    p50, p95, p99, mean = _percentiles(lat)
     duration = t_end - t0
+    goodput_rps, attainment = _goodput(lat, slo_ms, duration)
     return LoadReport(
         n_requests=len(prompts), completed=len(done), duration_s=duration,
         tokens_out=tokens,
         tokens_per_sec=tokens / duration if duration > 0 else 0.0,
         offered_rps=rate_rps, latency_p50_ms=p50, latency_p95_ms=p95,
-        latency_p99_ms=p99, latency_mean_ms=mean)
+        latency_p99_ms=p99, latency_mean_ms=mean,
+        slo_ms=slo_ms, goodput_rps=goodput_rps,
+        slo_attainment=attainment)

@@ -16,6 +16,15 @@ Three layers, one subsystem:
   protocol and blob metas) + a per-process crash flight recorder dumped
   on error/SIGTERM and checkpointed write-ahead at round boundaries —
   merged into round timelines by tools/trace_report.py;
+- **watch** (history.py + alerts.py, ISSUE 15): a bounded time-series
+  history sampled from the registry (range/rate/delta queries, windowed
+  histogram-delta percentiles, crash-readable JSONL spill) and a
+  declarative alert engine over it (threshold / rate-of-change /
+  absence-staleness / burn-rate SLO rules with for_s hysteresis) whose
+  firing verdicts bump ``alerts_firing``, dump flight-recorder
+  forensics, and publish into the tracker KV for the cluster alert view
+  — served at ``/api/history`` and ``/api/alerts``, reported by
+  tools/alert_report.py;
 - **federation** (federation.py, ISSUE 12): per-process registries
   pushed as versioned JSON snapshots through the StateTracker KV map and
   merged into one cluster view (counters sum, gauges per-process,
@@ -40,6 +49,22 @@ from deeplearning4j_tpu.telemetry.federation import (
     ClusterAggregator,
     MetricsPusher,
     merge_snapshots,
+)
+from deeplearning4j_tpu.telemetry.alerts import (
+    AlertEngine,
+    AlertRule,
+    Watchtower,
+    arm_watchtower,
+    default_rules,
+    get_engine,
+    set_engine,
+)
+from deeplearning4j_tpu.telemetry.history import (
+    MetricsHistory,
+    get_history,
+    read_spill,
+    replay_spill,
+    set_history,
 )
 from deeplearning4j_tpu.telemetry.prometheus import (
     CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE,
@@ -87,6 +112,8 @@ from deeplearning4j_tpu.telemetry.xprofile import (
 )
 
 __all__ = [
+    "AlertEngine",
+    "AlertRule",
     "ClusterAggregator",
     "Counter",
     "DEFAULT_BUCKETS",
@@ -94,6 +121,7 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MemoryWatermarkSampler",
+    "MetricsHistory",
     "MetricsPusher",
     "MetricsRegistry",
     "PROMETHEUS_CONTENT_TYPE",
@@ -104,19 +132,28 @@ __all__ = [
     "StepProfile",
     "Tracer",
     "TrainTelemetry",
+    "Watchtower",
+    "arm_watchtower",
     "attribute",
     "default_profile_store",
     "profile_compiled",
     "profile_lowered",
     "current_trace_context",
     "default_registry",
+    "default_rules",
     "flat_record",
     "format_traceparent",
+    "get_engine",
+    "get_history",
     "get_tracer",
     "maybe_span",
     "merge_snapshots",
     "parse_traceparent",
+    "read_spill",
     "render_snapshot",
+    "replay_spill",
+    "set_engine",
+    "set_history",
     "set_tracer",
     "global_norm",
     "read_step_log",

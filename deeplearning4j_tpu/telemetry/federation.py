@@ -306,3 +306,61 @@ class ClusterAggregator:
         from deeplearning4j_tpu.telemetry.registry import flat_record
 
         return flat_record(self.registry, prefixes=("federation_",))
+
+    # ---------------------------------------------- cluster alerts (ISSUE 15) ----
+    def collect_alerts(self) -> Dict[str, Any]:
+        """The cluster-wide alert view: one ``kv_snapshot`` read of every
+        process's published alert payload (telemetry/alerts.AlertEngine
+        publishes under ``federation.alerts.<process>``), schema-gated
+        and staleness-marked exactly like the metric payloads. Alerts
+        keep their per-process identity (a ``process`` field per row —
+        summing verdicts would destroy the routing signal); ``firing``
+        is the cluster-wide count of currently-firing rules — the single
+        number a router or hot-swap gate dispatches on."""
+        from deeplearning4j_tpu.telemetry.alerts import (
+            ALERT_KV_PREFIX,
+            SCHEMA as ALERTS_SCHEMA,
+        )
+
+        now = time.time()
+        try:
+            raw = self._tracker.kv_snapshot(ALERT_KV_PREFIX)
+        except (ConnectionError, OSError) as exc:
+            self.registry.counter("federation_collect_failures_total").inc()
+            return {"schema": ALERTS_SCHEMA, "ts": now, "error": str(exc),
+                    "stale_after_s": self.stale_after_s,
+                    "processes": [], "alerts": [], "firing": 0}
+        processes: List[Dict] = []
+        alerts: List[Dict] = []
+        for key in sorted(raw):
+            try:
+                payload = json.loads(raw[key])
+            except (TypeError, ValueError):
+                self.registry.counter("federation_bad_payloads_total").inc()
+                continue
+            if (not isinstance(payload, dict)
+                    or payload.get("schema") != ALERTS_SCHEMA):
+                self.registry.counter("federation_bad_payloads_total").inc()
+                continue
+            process = payload.get("process",
+                                  key[len(ALERT_KV_PREFIX):])
+            age = now - float(payload.get("ts", 0.0))
+            stale = age > self.stale_after_s
+            processes.append({"process": process,
+                              "pid": payload.get("pid"),
+                              "seq": payload.get("seq"),
+                              "ts": payload.get("ts"),
+                              "age_s": round(age, 3), "stale": stale})
+            for row in payload.get("alerts") or []:
+                if isinstance(row, dict):
+                    alerts.append(dict(row, process=process, stale=stale))
+        alerts.sort(key=lambda a: (a.get("state") != "firing",
+                                   str(a.get("severity")),
+                                   str(a.get("rule"))))
+        firing = sum(a.get("state") == "firing" for a in alerts)
+        self.registry.gauge("federation_cluster_alerts_firing").set(
+            float(firing))
+        return {"schema": ALERTS_SCHEMA, "ts": now,
+                "stale_after_s": self.stale_after_s,
+                "processes": processes, "alerts": alerts,
+                "firing": firing}

@@ -88,10 +88,22 @@ def render_snapshot(snap: Dict) -> str:
     for row in snap["histograms"]:
         family = sanitize_name(row["name"])
         type_line(family, "histogram")
+        # trace exemplars (ISSUE 15): OpenMetrics exemplar syntax appended
+        # to the owning bucket's sample line — ``... # {trace_id="…"}
+        # <value> <ts>``. Only present when the histogram captured trace
+        # ids (a tracer was configured), so a strict text-0.0.4 scrape of
+        # an untraced process is byte-identical to the pre-exemplar
+        # output (the golden test pins that).
+        ex_by_le = {e["le"]: e for e in row.get("exemplars", [])}
         for b in row["buckets"]:
             le_label = 'le="%s"' % _fmt(b["le"])
             labels = _labels_str(row["labels"], le_label)
-            lines.append(f"{family}_bucket{labels} {b['count']}")
+            line = f"{family}_bucket{labels} {b['count']}"
+            ex = ex_by_le.get(b["le"])
+            if ex is not None:
+                line += (f' # {{trace_id="{_escape_label_value(str(ex["trace_id"]))}"}}'
+                         f' {_fmt(ex["value"])} {round(float(ex["ts"]), 3)}')
+            lines.append(line)
         lines.append(
             f"{family}_sum{_labels_str(row['labels'])} {_fmt(row['sum'])}")
         lines.append(

@@ -43,6 +43,7 @@ tracer all hit one registry concurrently with training-loop writers):
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from deeplearning4j_tpu.utils.lockwatch import make_rlock
@@ -53,6 +54,24 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
 )
 
 LabelDict = Optional[Dict[str, str]]
+
+
+def _now() -> float:
+    return time.time()
+
+
+def _current_trace_id() -> Optional[str]:
+    """The calling thread's current span's trace id, or None when
+    tracing is off / no span is open — the zero-cost exemplar capture
+    seam (ISSUE 15). Imported lazily: trace.py pulls default_registry
+    from here, so a top-level import would cycle."""
+    from deeplearning4j_tpu.telemetry import trace as _trace
+
+    tracer = _trace.get_tracer()
+    if tracer is None:
+        return None
+    sp = tracer.current_span()
+    return sp.trace_id if sp is not None else None
 
 
 def _label_key(labels: LabelDict) -> Tuple[Tuple[str, str], ...]:
@@ -107,28 +126,61 @@ class Histogram:
         self._counts = [0] * (len(bs) + 1)  # last slot = +Inf
         self._sum = 0.0
         self._count = 0
+        # trace exemplars (ISSUE 15): per-bucket latest {trace_id, value,
+        # ts} — the metrics→trace correlation hook. Empty unless a trace
+        # id was captured, so snapshots/rendering are unchanged when
+        # tracing is off.
+        self._exemplars: Dict[int, Dict] = {}
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None) -> None:
+        """Record one observation. ``exemplar`` optionally attaches a
+        trace id to the observation's bucket (latest wins per bucket);
+        with ``exemplar=None`` the calling thread's CURRENT span (the
+        process tracer's) is captured when one is open — a dict lookup
+        when tracing is off, nothing stored when no span is current."""
         value = float(value)
+        if exemplar is None:
+            exemplar = _current_trace_id()
         with self._lock:
             self._sum += value
             self._count += 1
             for i, b in enumerate(self.bounds):
                 if value <= b:
-                    self._counts[i] += 1
+                    idx = i
                     break
             else:
-                self._counts[-1] += 1
+                idx = len(self.bounds)
+            self._counts[idx] += 1
+            if exemplar is not None:
+                self._exemplars[idx] = {"trace_id": str(exemplar),
+                                        "value": value, "ts": _now()}
+
+    def exemplars(self) -> List[Dict]:
+        """Recorded exemplars, one per bucket that has one:
+        ``{"le", "trace_id", "value", "ts"}`` sorted by bucket bound."""
+        with self._lock:
+            bounds = list(self.bounds) + [float("inf")]
+            return [{"le": bounds[i], **dict(self._exemplars[i])}
+                    for i in sorted(self._exemplars)]
 
     def snapshot(self) -> Dict:
-        """Cumulative bucket counts (Prometheus ``le`` semantics) + sum/count."""
+        """Cumulative bucket counts (Prometheus ``le`` semantics) + sum/count.
+        Carries an ``exemplars`` list only when trace exemplars were
+        captured (absent otherwise — downstream consumers that predate
+        them see the exact old shape)."""
         with self._lock:
             cum, acc = [], 0
             for i, b in enumerate(self.bounds):
                 acc += self._counts[i]
                 cum.append({"le": b, "count": acc})
             cum.append({"le": float("inf"), "count": acc + self._counts[-1]})
-            return {"buckets": cum, "sum": self._sum, "count": self._count}
+            out = {"buckets": cum, "sum": self._sum, "count": self._count}
+            if self._exemplars:
+                bounds = list(self.bounds) + [float("inf")]
+                out["exemplars"] = [
+                    {"le": bounds[i], **dict(self._exemplars[i])}
+                    for i in sorted(self._exemplars)]
+            return out
 
     @property
     def count(self) -> int:

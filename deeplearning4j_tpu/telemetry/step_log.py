@@ -196,8 +196,12 @@ def summarize_step_log(records: List[Dict]) -> Dict:
     # federation metrics_record via registry.flat_record) surface as one
     # block each — cumulative registry values, so the latest record wins;
     # absent keys mean the subsystem never ran and the block is omitted
+    # alerts_/history_ (ISSUE 15): the watchtower's own health metrics,
+    # same silent-when-absent contract (pinned by the ISSUE 15 meta-test)
     for prefix, block_key in (("serve_", "serve"),
-                              ("federation_", "federation")):
+                              ("federation_", "federation"),
+                              ("alerts_", "alerts"),
+                              ("history_", "history")):
         block: Dict = {}
         for r in records:
             for k, v in r.items():

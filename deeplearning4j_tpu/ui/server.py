@@ -31,6 +31,8 @@ INDEX_HTML = """<!doctype html>
 <li><a href="/api/memory">device memory stats</a></li>
 <li><a href="/api/trace">live trace spans (open + recent)</a></li>
 <li><a href="/api/profile">compiled-step profiles (cost/memory/collectives)</a></li>
+<li><a href="/api/history">metrics history (series index; ?name=&window_s=)</a></li>
+<li><a href="/api/alerts">alert states (rules, hysteresis, exemplars)</a></li>
 </ul>
 <h2>serving</h2>
 <ul>
@@ -40,6 +42,7 @@ INDEX_HTML = """<!doctype html>
 <h2>cluster</h2>
 <ul>
 <li><a href="/api/cluster">federated cluster metrics (merged registries + staleness)</a></li>
+<li><a href="/api/alerts?scope=cluster">cluster-wide alert view (merged per-process alerts)</a></li>
 <li><a href="/metrics?scope=cluster">cluster-scope Prometheus metrics</a></li>
 </ul>
 <h2>api</h2>
@@ -80,6 +83,8 @@ class UiServer:
         self._profile_store = None
         self._engine = None
         self._federation = None
+        self._history = None
+        self._alerts = None
         self._generate_timeout_s = 120.0
 
     # ---- telemetry (ISSUE 2: Prometheus + JSON export on the UI port) ----
@@ -121,6 +126,25 @@ class UiServer:
         handler drives the scheduler inline."""
         self._engine = engine
         self._generate_timeout_s = float(generate_timeout_s)
+
+    # ---- watchtower (ISSUE 15: history + alert verdicts on the UI port) ----
+    def attach_history(self, history) -> None:
+        """Serve a telemetry.history.MetricsHistory at ``/api/history``:
+        the series index, and with ``?name=<metric>[&window_s=N]`` the
+        scalar points of one series. Read at request time; falls back to
+        the process history (telemetry.history.get_history) when none is
+        attached explicitly."""
+        self._history = history
+
+    def attach_alerts(self, engine) -> None:
+        """Serve a telemetry.alerts.AlertEngine at ``/api/alerts``: every
+        rule's current state (inactive/pending/firing/resolved with
+        timestamps, measured value, and — for SLO-burn rules — the
+        offending exemplar trace ids). ``?scope=cluster`` serves the
+        tracker-merged per-process alert view through the attached
+        federation aggregator instead. Read at request time; falls back
+        to the process engine (telemetry.alerts.get_engine)."""
+        self._alerts = engine
 
     # ---- federation (ISSUE 12: the cluster view on the UI port) ----
     def attach_federation(self, aggregator) -> None:
@@ -282,6 +306,56 @@ class UiServer:
                         self._json(rec)
                         return
                     self._json({"profiles": store.snapshot()})
+                elif url.path == "/api/history":
+                    from deeplearning4j_tpu.telemetry import (
+                        history as _history_mod,
+                    )
+
+                    hist = ui._history or _history_mod.get_history()
+                    if hist is None:
+                        self._json({"error": "no metrics history "
+                                    "attached"}, 404)
+                        return
+                    name = q.get("name", [None])[0]
+                    try:
+                        window_s = (float(q.get("window_s")[0])
+                                    if q.get("window_s") else None)
+                    except ValueError:
+                        self._json({"error": "window_s must be a number"},
+                                   400)
+                        return
+                    self._json(hist.snapshot(name=name, window_s=window_s))
+                elif url.path == "/api/alerts":
+                    scope = q.get("scope", ["process"])[0]
+                    if scope == "cluster":
+                        # ISSUE 15: the tracker-merged cluster alert view
+                        # (every process's published AlertEngine payload)
+                        if ui._federation is None:
+                            self._json({"error": "no federation "
+                                        "aggregator attached"}, 404)
+                            return
+                        self._json(ui._federation.collect_alerts())
+                        return
+                    if scope != "process":
+                        self._json({"error": "scope must be 'process' or "
+                                    "'cluster'"}, 400)
+                        return
+                    from deeplearning4j_tpu.telemetry import (
+                        alerts as _alerts_mod,
+                    )
+
+                    engine = ui._alerts or _alerts_mod.get_engine()
+                    if engine is None:
+                        self._json({"error": "no alert engine attached"},
+                                   404)
+                        return
+                    states = engine.states()
+                    self._json({
+                        "process": engine.process,
+                        "firing": sum(a["state"] == "firing"
+                                      for a in states),
+                        "alerts": states,
+                    })
                 elif url.path == "/api/serve":
                     if ui._engine is None:
                         self._json({"error": "no decode engine attached"},

@@ -406,6 +406,15 @@ def test_bench_serve_stage_on_cpu():
     assert sd["naive_tokens_per_sec"] > 0
     assert sd["occupancy_mean"] > 0
     assert sd["serve_dtype"] == "bf16"
+    # goodput under SLO (ISSUE 15 satellite): reported alongside the
+    # percentiles and coherent with them — attainment is a fraction and
+    # goodput can never exceed completed/duration
+    gp = sd["goodput"]
+    assert gp["slo_ms"] > 0
+    assert 0.0 <= gp["slo_attainment"] <= 1.0
+    assert gp["goodput_rps"] >= 0.0
+    assert gp["goodput_rps"] <= sd["completed"] / max(
+        sd["latency"]["p50_ms"] / 1000.0, 1e-9)
     # lockwatch twin (ISSUE 11): the watched run stays cycle-free and
     # inside the <5% tokens/s budget (shared-CPU noise: one retry below
     # rides the serve_vs_naive retry)
@@ -437,6 +446,58 @@ def test_bench_serve_stage_on_cpu():
     assert sd["serve_vs_naive"] > 1.0, sd
     assert sd["lockwatch"]["overhead_pct"] < 5.0, sd["lockwatch"]
     assert sd["tracing"]["overhead_pct"] < 5.0, sd["tracing"]
+
+
+def test_bench_observability_stage_on_cpu():
+    """ISSUE 15 acceptance: the observability stage runs end to end on
+    the CPU backend — the SAME open-loop serve run with the watch layer
+    armed (history sampler at 20Hz + alert engine on the default pack at
+    10Hz) costs <5% tokens/s (the shared noise retry keeps the gate
+    honest on a loaded box), the quiet run fires NOTHING, the armed
+    run's history answers live rate/percentile queries, and the
+    deterministic injected-fault demo drives nonfinite_step_rate AND
+    serve_latency_slo_burn to firing with the transitions rendered
+    through the REAL tools/alert_report.py."""
+
+    def run_stage():
+        env = dict(os.environ)
+        env["BENCH_FORCE_CPU"] = "1"
+        env["BENCH_FAST"] = "1"
+        env["BENCH_BUDGET_SEC"] = "240"
+        env["BENCH_ONLY"] = "observability"
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True, text=True, timeout=300, cwd=REPO, env=env,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        det = json.loads(out.stdout.strip().splitlines()[-1])["detail"]
+        assert det.get("observability_overhead_pct") is not None, det.get(
+            "observability_status")
+        return det["observability_detail"]
+
+    sd = run_stage()
+    # stable structure (no retry needed)
+    assert sd["tokens_per_sec"] > 0
+    assert sd["tokens_per_sec_watched"] > 0
+    hist = sd["history"]
+    assert hist["samples"] >= 2          # sampler really ran
+    assert hist["series"] > 0
+    assert hist["serve_tokens_rate_per_s"] > 0   # live rate query worked
+    al = sd["alerts"]
+    assert al["rules"] == 8
+    # a healthy run pages nobody
+    assert al["quiet_run_firing"] == []
+    # the injected-fault demo fired BOTH demo rules deterministically...
+    assert al["demo_states"] == {"nonfinite_step_rate": "firing",
+                                 "serve_latency_slo_burn": "firing"}
+    # ...and the real alert_report rendered the transitions
+    assert al["report_transitions"] >= 2
+    assert al["report_fired"] == ["nonfinite_step_rate",
+                                  "serve_latency_slo_burn"]
+    # the armed-watch overhead budget, with the shared noise retry
+    if sd["overhead_pct"] >= 5.0:  # noise-floor retry, see docstring
+        sd = run_stage()
+    assert sd["overhead_pct"] < 5.0, sd
 
 
 def test_bench_comm_overlap_stage_on_cpu():

@@ -294,6 +294,29 @@ def test_open_loop_drives_engine_to_completion(params):
     assert rep.latency_mean_ms > 0
     d = rep.to_dict()
     assert d["offered_rps"] == 300.0
+    # without an SLO the goodput fields are explicitly absent-as-None
+    assert d["slo_ms"] is None and d["goodput_rps"] is None
+
+
+def test_open_loop_goodput_under_slo(params):
+    """ISSUE 15 satellite: ``slo_ms`` turns the open-loop run into a
+    goodput measurement — requests completing WITHIN the SLO per second,
+    with attainment the matching fraction. Pinned at the two boundary
+    SLOs (impossible → 0 goodput, generous → all requests count) so the
+    accounting can't drift from the latency percentiles."""
+    eng = DecodeEngine(params, H, n_slots=2, max_len=MAXLEN,
+                       serve_dtype=None)
+    eng.generate([1] * 5, max_new_tokens=2)  # warm
+    prompts = _prompts(6, seed=8)
+    tight = run_open_loop(eng, prompts, rate_rps=300.0,
+                          max_new_tokens=4, slo_ms=1e-9)
+    assert tight.slo_attainment == 0.0 and tight.goodput_rps == 0.0
+    loose = run_open_loop(eng, prompts, rate_rps=300.0,
+                          max_new_tokens=4, slo_ms=1e9)
+    assert loose.slo_attainment == 1.0
+    assert loose.goodput_rps == pytest.approx(
+        loose.completed / loose.duration_s)
+    assert loose.to_dict()["goodput_rps"] == loose.goodput_rps
 
 
 # ----------------------------------------- checkpoint loading (serving) ----

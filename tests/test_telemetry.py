@@ -1021,6 +1021,41 @@ class TestServeFederationReport:
                     or f"{name}_count" in summary["federation"]), name
             assert name in text, f"{name} not rendered by telemetry_report"
 
+    def test_meta_every_alerts_and_history_metric_rendered(self, tmp_path):
+        """ISSUE 15: the watchtower's own health metrics (``alerts_*``
+        from a live AlertEngine, ``history_*`` from a live
+        MetricsHistory) render through the report, pinned off the REAL
+        registry names so a new watch metric can't ship unrendered."""
+        from deeplearning4j_tpu.telemetry.alerts import AlertEngine
+        from deeplearning4j_tpu.telemetry.history import MetricsHistory
+
+        reg = MetricsRegistry()
+        reg.counter("guard_skipped_steps_total").inc(0)
+        history = MetricsHistory(registry=reg)
+        engine = AlertEngine(history, registry=reg, process="meta")
+        history.sample_once(now=1000.0)
+        reg.counter("guard_skipped_steps_total").inc(2)
+        history.sample_once(now=1010.0)
+        engine.evaluate_once(now=1010.0, publish=False)
+        rec = dict(history.metrics_record(), **engine.metrics_record())
+        for prefix, block in (("alerts_", "alerts"),
+                              ("history_", "history")):
+            names = self._registry_names(reg, prefix)
+            assert names
+            path = str(tmp_path / f"steps_{block}.jsonl")
+            with StepLogWriter(path) as w:
+                w.write(0, loss=1.0, **rec)
+            summary = summarize_step_log(read_step_log(path))
+            text = self._run_report(path)
+            title = ("alert metrics (registry)" if block == "alerts"
+                     else "history metrics (registry)")
+            assert title in text
+            for name in sorted(names):
+                assert (name in summary[block]
+                        or f"{name}_count" in summary[block]), name
+                assert name in text, \
+                    f"{name} not rendered by telemetry_report"
+
     def test_silent_without_serve_or_federation_metrics(self, tmp_path):
         path = str(tmp_path / "steps.jsonl")
         with StepLogWriter(path) as w:
@@ -1028,6 +1063,10 @@ class TestServeFederationReport:
             w.write(1, loss=0.5)
         summary = summarize_step_log(read_step_log(path))
         assert "serve" not in summary and "federation" not in summary
+        for key in ("alerts", "history"):
+            assert key not in summary
         text = self._run_report(path)
         assert "serve metrics" not in text
         assert "federation metrics" not in text
+        assert "alert metrics" not in text
+        assert "history metrics" not in text

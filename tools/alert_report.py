@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""Render a watchtower directory (alert transitions + metrics history)
+into an alert/history timeline.
+
+Usage:
+    python tools/alert_report.py WATCH_DIR [--json] [--window-s N]
+    python tools/alert_report.py --alerts-log F [--history F2] [--json]
+
+``WATCH_DIR`` is what ``telemetry.alerts.arm_watchtower(out_dir=...)``
+(or ``ElasticMaster(watch=True, watch_dir=...)``, or the elastic worker
+CLI's ``--watch-dir``) wrote: ``alerts_<process>.jsonl`` transition logs
+plus ``history_<process>.jsonl`` write-ahead spill files. Both are
+crash-readable — a killed process leaves every completed line — so this
+report reconstructs what the watch layer saw right up to the death.
+
+Output:
+
+- the **alert timeline**: every state transition in wall-clock order
+  (rule, from→to, measured value, severity) across every process;
+- the **final verdict table**: each rule's last-known state per process;
+- a **history digest** per process: for every metric a firing/resolved
+  rule referenced, first→last / min / max over the spill (replayed
+  through the REAL telemetry.history query code, so the report can never
+  disagree with what the live engine computed).
+
+``--json`` emits the raw structure (CI-friendly). Exit codes: 2 when
+inputs are missing, 3 when they hold no records.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu.telemetry.alerts import SCHEMA  # noqa: E402
+from deeplearning4j_tpu.telemetry.history import replay_spill  # noqa: E402
+
+
+def read_alert_log(path: str) -> List[Dict]:
+    """Parse one transitions JSONL (tolerant of a torn tail line — the
+    writer may have died mid-transition; everything earlier is complete
+    by the line-buffered write contract)."""
+    out: List[Dict] = []
+    with open(path) as fh:
+        lines = fh.read().splitlines()
+    for lineno, line in enumerate(lines, start=1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as exc:
+            if lineno == len(lines):
+                break
+            raise ValueError(f"alert log {path} is corrupt at line "
+                             f"{lineno}: {exc}") from exc
+        if isinstance(rec, dict) and rec.get("schema") == SCHEMA:
+            out.append(rec)
+    return out
+
+
+def _process_of(path: str, prefix: str) -> str:
+    base = os.path.basename(path)
+    return base[len(prefix):-len(".jsonl")] if base.startswith(prefix) \
+        else base
+
+
+def collect(watch_dir: Optional[str] = None,
+            alerts_logs: Optional[List[str]] = None,
+            history_spills: Optional[List[str]] = None,
+            window_s: Optional[float] = None) -> Dict:
+    """The report structure: timeline + per-rule last states + history
+    digests (module docstring)."""
+    alerts_logs = list(alerts_logs or [])
+    history_spills = list(history_spills or [])
+    if watch_dir:
+        alerts_logs += sorted(glob.glob(
+            os.path.join(watch_dir, "alerts_*.jsonl")))
+        history_spills += sorted(glob.glob(
+            os.path.join(watch_dir, "history_*.jsonl")))
+    timeline: List[Dict] = []
+    for path in alerts_logs:
+        process = _process_of(path, "alerts_")
+        for rec in read_alert_log(path):
+            timeline.append(dict(rec, process=process))
+    timeline.sort(key=lambda r: r.get("ts", 0.0))
+    if window_s is not None and timeline:
+        cut = timeline[-1]["ts"] - float(window_s)
+        timeline = [r for r in timeline if r.get("ts", 0.0) >= cut]
+    # final verdicts: last transition per (process, rule)
+    last: Dict[tuple, Dict] = {}
+    for rec in timeline:
+        last[(rec["process"], rec["rule"])] = rec
+    verdicts = [{"process": p, "rule": r, "state": rec["to"],
+                 "severity": rec.get("severity"),
+                 "value": rec.get("value"), "ts": rec.get("ts")}
+                for (p, r), rec in sorted(last.items())]
+    histories = []
+    for path in history_spills:
+        process = _process_of(path, "history_")
+        try:
+            hist = replay_spill(path)
+        except ValueError as exc:
+            histories.append({"process": process, "error": str(exc)})
+            continue
+        digest = []
+        for row in hist.series_index():
+            if row["kind"] == "histogram":
+                digest.append({"name": row["name"], "kind": "histogram",
+                               "labels": row["labels"],
+                               "observations": row["last_value"],
+                               "points": row["points"]})
+                continue
+            pts = hist.points(row["name"], row["labels"] or None,
+                              now=row["last_ts"])
+            vals = [v for _, v in pts]
+            digest.append({
+                "name": row["name"], "kind": row["kind"],
+                "labels": row["labels"], "points": len(pts),
+                "first": vals[0] if vals else None,
+                "last": vals[-1] if vals else None,
+                "min": min(vals) if vals else None,
+                "max": max(vals) if vals else None,
+            })
+        histories.append({"process": process, "samples": hist._samples,
+                          "series": digest})
+    return {"schema": SCHEMA, "ts": time.time(),
+            "transitions": timeline, "verdicts": verdicts,
+            "histories": histories,
+            "n_alert_logs": len(alerts_logs),
+            "n_history_spills": len(history_spills)}
+
+
+def render_text(report: Dict, source: str) -> str:
+    lines = [f"alert report — {source}",
+             f"{len(report['transitions'])} transition(s), "
+             f"{report['n_alert_logs']} alert log(s), "
+             f"{report['n_history_spills']} history spill(s)"]
+    if report["transitions"]:
+        hdr = (f"{'when':<21}  {'process':<12}  {'rule':<28}  "
+               f"{'transition':<20}  {'value':>12}  severity")
+        lines += ["", hdr, "-" * len(hdr)]
+        for rec in report["transitions"]:
+            when = time.strftime("%Y-%m-%d %H:%M:%S",
+                                 time.localtime(rec.get("ts", 0.0)))
+            val = rec.get("value")
+            val = f"{val:.4g}" if isinstance(val, (int, float)) else "-"
+            lines.append(
+                f"{when:<21}  {rec['process']:<12}  {rec['rule']:<28}  "
+                f"{rec['from']+' -> '+rec['to']:<20}  {val:>12}  "
+                f"{rec.get('severity', '-')}")
+    if report["verdicts"]:
+        lines += ["", "final verdicts (last transition per rule)"]
+        for v in report["verdicts"]:
+            flag = "!! " if v["state"] == "firing" else "   "
+            lines.append(f"{flag}{v['process']}/{v['rule']}: {v['state']} "
+                         f"({v['severity']})")
+    for h in report["histories"]:
+        if "error" in h:
+            lines += ["", f"history [{h['process']}]: UNREADABLE — "
+                      f"{h['error']}"]
+            continue
+        lines += ["", f"history [{h['process']}] — {h['samples']} "
+                  f"sample(s)"]
+        width = max((len(r["name"]) for r in h["series"]), default=4)
+        for r in h["series"]:
+            lbl = ("{" + ",".join(f"{k}={v}" for k, v in
+                                  sorted(r["labels"].items())) + "}"
+                   if r["labels"] else "")
+            if r["kind"] == "histogram":
+                lines.append(f"  {r['name']:<{width}}{lbl} "
+                             f"histogram, {r['observations']:g} obs")
+            else:
+                lines.append(
+                    f"  {r['name']:<{width}}{lbl} "
+                    f"{r['first']:g} -> {r['last']:g} "
+                    f"(min {r['min']:g}, max {r['max']:g})")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("watch_dir", nargs="?", default=None,
+                    help="directory of alerts_*.jsonl / history_*.jsonl")
+    ap.add_argument("--alerts-log", action="append", default=[],
+                    help="explicit alert transitions JSONL (repeatable)")
+    ap.add_argument("--history", action="append", default=[],
+                    help="explicit history spill JSONL (repeatable)")
+    ap.add_argument("--window-s", type=float, default=None,
+                    help="keep only transitions within N seconds of the "
+                         "latest one")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw report structure")
+    args = ap.parse_args(argv)
+    if args.watch_dir and not os.path.isdir(args.watch_dir):
+        print(f"no such watch dir: {args.watch_dir}", file=sys.stderr)
+        return 2
+    for path in list(args.alerts_log) + list(args.history):
+        if not os.path.isfile(path):
+            print(f"no such file: {path}", file=sys.stderr)
+            return 2
+    if not args.watch_dir and not args.alerts_log and not args.history:
+        print("nothing to report: pass WATCH_DIR, --alerts-log, or "
+              "--history", file=sys.stderr)
+        return 2
+    try:
+        report = collect(args.watch_dir, args.alerts_log, args.history,
+                         window_s=args.window_s)
+    except ValueError as exc:
+        print(str(exc), file=sys.stderr)
+        return 3
+    if (not report["transitions"] and not report["histories"]):
+        print("no alert transitions or history samples found "
+              "(was the watchtower armed with an out_dir?)",
+              file=sys.stderr)
+        return 3
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(render_text(report,
+                          args.watch_dir or "explicit files"))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

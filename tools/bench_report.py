@@ -154,6 +154,27 @@ def _latency_metrics(detail: Dict) -> Dict[str, float]:
     return out
 
 
+def _goodput_metrics(detail: Dict) -> Dict[str, float]:
+    """Goodput-under-SLO rows (ISSUE 15 satellite): a stage detail
+    carrying a ``goodput`` block (the serving bench's open-loop run with
+    ``slo_ms`` set) contributes ``<stage>_goodput_rps`` — requests that
+    completed WITHIN the SLO per second, tracked HIGHER-IS-BETTER (the
+    default regression direction), the metric the fleet bench gates on:
+    raw tokens/s can grow while the SLO-violating tail grows faster,
+    goodput cannot."""
+    out: Dict[str, float] = {}
+    for key, val in detail.items():
+        if not key.endswith("_detail") or not isinstance(val, dict):
+            continue
+        gp = val.get("goodput")
+        if not isinstance(gp, dict):
+            continue
+        v = gp.get("goodput_rps")
+        if isinstance(v, (int, float)):
+            out[f"{key[: -len('_detail')]}_goodput_rps"] = float(v)
+    return out
+
+
 def load_rounds(bench_dir: str) -> List[Dict]:
     """One record per BENCH_r*.json: {round, source, metrics, headline}."""
     rounds = []
@@ -177,6 +198,7 @@ def load_rounds(bench_dir: str) -> List[Dict]:
             metrics.update(_profile_metrics(detail))
             metrics.update(_latency_metrics(detail))
             metrics.update(_wire_metrics(detail))
+            metrics.update(_goodput_metrics(detail))
             rounds.append({"round": int(m.group(1)), "source": "parsed",
                            "metrics": metrics,
                            "headline": parsed.get("value")})

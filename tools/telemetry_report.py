@@ -88,9 +88,12 @@ def format_report(summary: dict, path: str) -> str:
     # (registry.flat_record via the subsystem metrics_record()s); silent
     # otherwise — both directions pinned by the ISSUE 12 meta-test, so a
     # new metric under either prefix can never ship unrendered
+    # + alerts/history (ISSUE 15): the watchtower blocks, same contract
     for block_key, title in (("serve", "serve metrics (registry)"),
                              ("federation",
-                              "federation metrics (registry)")):
+                              "federation metrics (registry)"),
+                             ("alerts", "alert metrics (registry)"),
+                             ("history", "history metrics (registry)")):
         block = summary.get(block_key)
         if block:
             bw = max(len(k) for k in block)

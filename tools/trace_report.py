@@ -116,6 +116,41 @@ def load_trace_dir(trace_dir: str) -> Dict[str, Dict]:
     return spans
 
 
+def find_trace(spans: Dict[str, Dict], trace_id: str) -> Dict[str, Dict]:
+    """The spans of ONE trace, keyed by span_id (ISSUE 15: how an alert
+    exemplar's trace id resolves to real spans — a firing
+    serve_latency_slo_burn carries the offending request trace ids, and
+    this lookup turns each into its serve.request tree)."""
+    want = str(trace_id).lower()
+    return {sid: sp for sid, sp in spans.items()
+            if str(sp.get("trace_id", "")).lower() == want}
+
+
+def render_trace_text(trace_id: str, trace_spans: Dict[str, Dict]) -> str:
+    """One trace's spans as an indented start-ordered tree."""
+    lines = [f"trace {trace_id} — {len(trace_spans)} span(s)"]
+    children: Dict = {}
+    for sid, sp in trace_spans.items():
+        children.setdefault(sp.get("parent_id"), []).append(sid)
+
+    def emit(sid: str, depth: int) -> None:
+        sp = trace_spans[sid]
+        dur = (f"{sp['dur_ms']:.2f}ms" if sp.get("dur_ms") is not None
+               else "open")
+        lines.append(f"{'  ' * depth}{sp.get('name')} "
+                     f"[{sp.get('process')}] {dur} {sp.get('status')}")
+        for kid in sorted(children.get(sid, []),
+                          key=lambda k: trace_spans[k].get("start", 0.0)):
+            emit(kid, depth + 1)
+
+    roots = [sid for sid, sp in trace_spans.items()
+             if sp.get("parent_id") not in trace_spans]
+    for sid in sorted(roots,
+                      key=lambda k: trace_spans[k].get("start", 0.0)):
+        emit(sid, 1)
+    return "\n".join(lines)
+
+
 def _arrivals(round_info: Dict) -> List[Dict]:
     """Per-worker contribution arrival times for one round, preferring the
     master barrier span's events (one clock — the master's) and falling
@@ -386,6 +421,10 @@ def main(argv=None) -> int:
                     help="emit the merged timeline as JSON")
     ap.add_argument("--chrome", metavar="OUT",
                     help="also write a Chrome trace-event JSON export")
+    ap.add_argument("--trace-id", metavar="ID",
+                    help="render only the spans of ONE trace (the id an "
+                         "alert exemplar / /api/alerts carries); exits 1 "
+                         "when the trace has no spans here")
     args = ap.parse_args(argv)
     if not os.path.isdir(args.trace_dir):
         print(f"no such trace dir: {args.trace_dir}", file=sys.stderr)
@@ -395,6 +434,18 @@ def main(argv=None) -> int:
         print(f"no span records under {args.trace_dir} "
               "(expected spans_*.jsonl / flightrec_*.json)", file=sys.stderr)
         return 2
+    if args.trace_id:
+        trace_spans = find_trace(spans, args.trace_id)
+        if not trace_spans:
+            print(f"no spans for trace id {args.trace_id} under "
+                  f"{args.trace_dir}", file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps({"trace_id": args.trace_id,
+                              "spans": trace_spans}, indent=1))
+        else:
+            print(render_trace_text(args.trace_id, trace_spans))
+        return 0
     timeline = build_timeline(spans)
     serve_rows = serve_attribution(spans)
     if args.chrome:
