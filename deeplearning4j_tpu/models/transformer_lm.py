@@ -840,15 +840,19 @@ def lm_prefill(params: dict, tokens: Array, n_heads: int, top_k: int = 2,
 
 def _decode_block(layer_params: dict, h: Array, ck: Array, cv: Array,
                   positions: Array, n_heads: int, top_k: int) -> tuple:
-    """One decoder block for ONE new token per slot. h: (S, 1, d); ck/cv:
-    (S, H, T_max, Dh). Writes this step's K/V at ``positions`` FIRST, then
-    attends with the mask ``index <= position`` — so the freshly written
-    position is visible and stale cache beyond it never is. The attention
-    math mirrors ring_attention.reference_attention (same score scale,
-    same -1e30 mask, jax.nn.softmax): the masked terms underflow to exact
-    zeros, so the padded reduction is bitwise the oracle's unpadded one."""
+    """One decoder block for W new tokens per slot. h: (S, W, d); ck/cv:
+    (S, H, T_max, Dh). Writes this step's K/V at ``positions``..``positions
+    + W - 1`` FIRST, then attends with the per-query mask ``index <=
+    position + offset`` — so every freshly written position is visible to
+    the queries at or after it and stale cache beyond them never is. The
+    attention math mirrors ring_attention.reference_attention (same score
+    scale, same -1e30 mask, jax.nn.softmax): the masked terms underflow to
+    exact zeros, so the padded reduction is bitwise the oracle's unpadded
+    one. W=1 is the decode hot path; W=k+1 is the speculative verify step
+    (ISSUE 16) — the same math, so verify logits at offset i are exactly
+    what i sequential decode steps over the same tokens would produce."""
     hn = _layernorm(h, layer_params["ln_g"], layer_params["ln_b"])
-    q = _split_heads(hn @ layer_params["wq"], n_heads)    # (S, H, 1, Dh)
+    q = _split_heads(hn @ layer_params["wq"], n_heads)    # (S, H, W, Dh)
     k_new = _split_heads(hn @ layer_params["wk"], n_heads)
     v_new = _split_heads(hn @ layer_params["wv"], n_heads)
     write = jax.vmap(
@@ -857,9 +861,10 @@ def _decode_block(layer_params: dict, h: Array, ck: Array, cv: Array,
     ck = write(ck, k_new, positions)
     cv = write(cv, v_new, positions)
     scores = jnp.einsum("shqd,shkd->shqk", q, ck) / jnp.sqrt(
-        q.shape[-1] * 1.0)                                # (S, H, 1, T_max)
+        q.shape[-1] * 1.0)                                # (S, H, W, T_max)
+    pos_q = positions[:, None] + jnp.arange(h.shape[1])[None, :]  # (S, W)
     mask = (jnp.arange(ck.shape[2])[None, None, None, :]
-            <= positions[:, None, None, None])
+            <= pos_q[:, None, :, None])
     scores = jnp.where(mask, scores, -1e30)
     o = jnp.einsum("shqk,shkd->shqd", jax.nn.softmax(scores, -1), cv)
     # f32 score math, carry-dtype residual (identity at f32 — parity-safe)
@@ -952,6 +957,151 @@ def make_prefill_step(n_heads: int, top_k: int = 2,
         return {"k": ck, "v": cv}, sample_tokens(last, k, temp)
 
     return prefill
+
+
+def lm_verify_step(params: dict, cache: dict, tokens: Array,
+                   positions: Array, n_heads: int, top_k: int = 2) -> tuple:
+    """Speculative verify forward (ISSUE 16): W tokens per slot — tokens
+    (S, W) int32 land at ``positions``..``positions + W - 1`` in the cache
+    and per-position next-token logits (S, W, V) come back with the
+    updated cache. Column 0 is the slot's pending token, columns 1..W-1
+    the draft's proposals; because ``_decode_block`` computes offset i's
+    query against exactly the cache a sequential decode at position
+    ``positions + i`` would see, logits[:, i] are token-identical to i
+    single-token decode steps over the same inputs — ONE dispatch verifies
+    all k proposals. The caller must guarantee ``positions + W <=
+    T_max`` (``dynamic_update_slice`` clamps out-of-range starts, which
+    would silently overwrite live earlier positions)."""
+    h = params["embed"][tokens]                           # (S, W, d)
+
+    def step(h, xs):
+        layer_params, ck, cv = xs
+        h, ck, cv = _decode_block(layer_params, h, ck, cv, positions,
+                                  n_heads, top_k)
+        return h, (ck, cv)
+
+    h, (cks, cvs) = jax.lax.scan(
+        step, h, (params["blocks"], cache["k"], cache["v"]))
+    logits = h @ params["dec_w"] + params["dec_b"]        # (S, W, V)
+    return {"k": cks, "v": cvs}, logits
+
+
+def make_verify_step(n_heads: int, top_k: int = 2, donate_cache: bool = True,
+                     params_transform=None):
+    """The speculative-decoding flagship executable:
+    ``verify(params, cache, tokens, positions, temps, key, step_idx) ->
+    (cache, toks)`` with tokens (S, W) → toks (S, W) int32. toks[:, i] is
+    ``sample_tokens`` over the logits at offset i (greedy argmax for
+    ``temps <= 0`` — the value the acceptance rule compares draft
+    proposals against, and the value a plain decode step at that position
+    would emit). Shapes are fixed at (S, W = k+1), so one executable per
+    configured k and the 0-compile steady state holds. Sampling keys fold
+    in both ``step_idx`` and the offset, so the W positions draw
+    independent streams."""
+    transform = params_transform or (lambda p: p)
+
+    @partial(jax.jit, donate_argnums=(1,) if donate_cache else ())
+    def verify(params, cache, tokens, positions, temps, key, step_idx):
+        params = transform(params)
+        cache, logits = lm_verify_step(params, cache, tokens, positions,
+                                       n_heads, top_k)
+        k = jax.random.fold_in(key, step_idx)
+        toks = jnp.stack(
+            [sample_tokens(logits[:, i, :], jax.random.fold_in(k, i), temps)
+             for i in range(tokens.shape[1])], axis=1)
+        return cache, toks
+
+    return verify
+
+
+def make_chunk_prefill_step(n_heads: int, top_k: int = 2,
+                            donate_cache: bool = True,
+                            params_transform=None):
+    """Chunked/suffix prefill executable (ISSUE 16): ``chunk(params,
+    cache, tokens, start, last_idx, slot, temp, key, step_idx) -> (cache,
+    tok)`` — ONE slot's tokens (1, W) written at absolute positions
+    ``start``..``start + W - 1``, each query attending the slot's cache
+    at ``index <= start + offset`` (so a chunk sees every earlier chunk
+    AND any prefix-cache-seeded pages — the same write-then-mask math as
+    ``_decode_block``, token-identical to the one-shot ``lm_prefill``
+    path). ``tok`` samples the logits at in-chunk index ``last_idx``; the
+    engine uses it only from the final chunk (last_idx = prompt_len - 1 -
+    start) and ignores it from earlier ones. Compiles are keyed by W
+    alone (start/last_idx/slot traced), so a fixed ``prefill_chunk``
+    costs one executable. The caller must keep ``start + W <= T_max``
+    (the engine shifts the final chunk left to overlap — recomputing a
+    position from the same tokens rewrites the same values)."""
+    transform = params_transform or (lambda p: p)
+
+    @partial(jax.jit, donate_argnums=(1,) if donate_cache else ())
+    def chunk(params, cache, tokens, start, last_idx, slot, temp, key,
+              step_idx):
+        params = transform(params)
+        h = params["embed"][tokens]                       # (1, W, d)
+        pos = jnp.asarray(start, jnp.int32)[None]         # (1,)
+
+        def step(h, xs):
+            layer_params, ck, cv = xs
+            ck_s = jax.lax.dynamic_index_in_dim(ck, slot, 0, keepdims=True)
+            cv_s = jax.lax.dynamic_index_in_dim(cv, slot, 0, keepdims=True)
+            h, ck_s, cv_s = _decode_block(layer_params, h, ck_s, cv_s,
+                                          pos, n_heads, top_k)
+            ck = jax.lax.dynamic_update_slice_in_dim(ck, ck_s, slot, axis=0)
+            cv = jax.lax.dynamic_update_slice_in_dim(cv, cv_s, slot, axis=0)
+            return h, (ck, cv)
+
+        h, (cks, cvs) = jax.lax.scan(
+            step, h, (params["blocks"], cache["k"], cache["v"]))
+        logits = (h @ params["dec_w"] + params["dec_b"])[0]  # (W, V)
+        last = jax.lax.dynamic_index_in_dim(logits, last_idx, 0,
+                                            keepdims=False)
+        k = jax.random.fold_in(key, step_idx)
+        return {"k": cks, "v": cvs}, sample_tokens(last, k, temp)
+
+    return chunk
+
+
+def draft_truncate_params(params: dict, n_layers: int) -> dict:
+    """Layer-truncated draft LM (ISSUE 16): the flagship's first
+    ``n_layers`` decoder blocks with the SAME embedding and decoder head —
+    the zero-training draft for speculative decoding (proposals need only
+    be cheap and correlated; the verify step keeps outputs exact). Shares
+    the flagship's leaves (no copy), so a draft costs no extra weight
+    memory beyond its own cache."""
+    total = lm_n_layers(params)
+    if not (1 <= n_layers <= total):
+        raise ValueError(
+            f"draft n_layers must be in [1, {total}], got {n_layers}")
+    blocks = jax.tree_util.tree_map(lambda x: x[:n_layers],
+                                    params["blocks"])
+    return {"embed": params["embed"], "blocks": blocks,
+            "dec_w": params["dec_w"], "dec_b": params["dec_b"]}
+
+
+def draft_distill_loss(teacher_params: dict, n_heads: int, top_k: int = 2,
+                       attn_impl: Optional[str] = None):
+    """Self-distillation objective for a TRAINED draft (ISSUE 16 — the
+    serving half feeding the training half): ``loss(draft_params, tokens)``
+    is the mean KL(teacher ‖ draft) over every position, with the teacher
+    (flagship) forward under ``stop_gradient``. Plug it into the existing
+    trainers exactly like ``dense_loss_fn`` — e.g. distill
+    ``draft_truncate_params(flagship, n)`` into a higher-acceptance draft
+    on the serving corpus, then hand the result to
+    ``DecodeEngine(speculative=SpeculativeConfig(draft_params=...))``."""
+    def loss(draft_params: dict, tokens: Array) -> Array:
+        core = lambda q, k, v: attention_core(q, k, v, causal=True,  # noqa: E731
+                                              impl=attn_impl)
+        t_logits, _ = lm_forward(teacher_params, tokens, n_heads, core,
+                                 partial(dense_moe, top_k=top_k))
+        t_logp = jax.nn.log_softmax(
+            jax.lax.stop_gradient(t_logits), axis=-1)
+        d_logits, _ = lm_forward(draft_params, tokens, n_heads, core,
+                                 partial(dense_moe, top_k=top_k))
+        d_logp = jax.nn.log_softmax(d_logits, axis=-1)
+        return jnp.mean(jnp.sum(jnp.exp(t_logp) * (t_logp - d_logp),
+                                axis=-1))
+
+    return loss
 
 
 def lm_dims(params: dict) -> dict:

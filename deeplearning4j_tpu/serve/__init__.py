@@ -10,11 +10,17 @@ from deeplearning4j_tpu.serve.loadgen import (
     run_open_loop,
     run_open_loop_http,
 )
+from deeplearning4j_tpu.serve.prefix_cache import PrefixPageCache
 from deeplearning4j_tpu.serve.quant import (
     QuantTensor,
     dequantize_tree,
     params_nbytes,
     prepare_serve_params,
+)
+from deeplearning4j_tpu.serve.speculative import (
+    SpeculativeConfig,
+    accept_longest_prefix,
+    resolve_speculative,
 )
 
 __all__ = [
@@ -24,8 +30,12 @@ __all__ = [
     "arrival_schedule",
     "run_open_loop",
     "run_open_loop_http",
+    "PrefixPageCache",
     "QuantTensor",
+    "SpeculativeConfig",
+    "accept_longest_prefix",
     "dequantize_tree",
     "params_nbytes",
     "prepare_serve_params",
+    "resolve_speculative",
 ]

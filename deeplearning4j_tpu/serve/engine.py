@@ -52,6 +52,40 @@ engine scheduler thread. The begin records are written eagerly, so a
 ``tools/trace_report.py`` reconstructs, exactly like the elastic rounds.
 Unconfigured, all of it is a None-check per call site — zero cost, and
 the greedy-parity + 0-compile pins run tracer-armed in test_serve.py.
+
+Serving fast path (ISSUE 16) — three pure-schedule optimizations, each
+pinned token-identical to the cold/sequential oracle and each defaulting
+OFF:
+
+- ``prefix_cache=``: shared-prefix KV page reuse (serve/prefix_cache.py).
+  Admission looks up the longest cached page-aligned prefix, seeds the
+  slot's cache rows from the shared pages, and prefills ONLY the uncached
+  suffix; a FULL hit (cached prefix covers all but at most the last
+  prompt token) issues ZERO flagship prefill dispatches — the last prompt
+  token rides the ordinary decode tick, whose write-then-mask math
+  computes exactly the prefill's last-position logits. Every flagship
+  prefill-shaped dispatch (classic or chunk) counts
+  ``serve_prefill_dispatches_total``, which is what the full-hit test
+  asserts stays flat.
+- ``prefill_chunk=``: long prompts prefill in fixed-width chunks, ONE
+  chunk per scheduler iteration interleaved with decode ticks — a long
+  admission no longer head-of-line-blocks every running request's next
+  token. Chunk shapes are pinned at the configured width (the final
+  chunk shifts left to overlap rather than changing shape), so the
+  0-compile steady-state budget holds. While a slot is mid-prefill its
+  host position points at the next chunk's start, so the shared decode
+  dispatch's garbage write for that slot lands where the next chunk
+  overwrites it before any query can attend to it.
+- ``speculative=`` / ``DL4J_TPU_SERVE_SPEC``: draft/verify speculative
+  decoding (serve/speculative.py). A layer-truncated (or distilled)
+  draft proposes k tokens per slot via k cheap draft decode dispatches;
+  the flagship verifies all k in ONE ``make_verify_step`` dispatch of
+  width k+1, and the host accepts the longest matching prefix plus the
+  flagship's bonus token — 1 to k+1 tokens per flagship dispatch,
+  greedy streams exactly the non-speculative ones. Acceptance lands in
+  ``serve_spec_accepted_per_verify`` / the ``serve_spec_accept_rate``
+  gauge (watchtower's ``serve_spec_accept_collapse`` rule), verify
+  latency in ``serve_verify_step_ms`` with trace exemplars.
 """
 
 from __future__ import annotations
@@ -64,18 +98,30 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 
 from deeplearning4j_tpu.models.transformer_lm import (
+    draft_truncate_params,
     init_kv_cache,
     lm_dims,
+    make_chunk_prefill_step,
     make_decode_step,
     make_prefill_step,
+    make_verify_step,
+)
+from deeplearning4j_tpu.serve.prefix_cache import (
+    PrefixPageCache,
+    seed_slot_pages,
 )
 from deeplearning4j_tpu.serve.quant import (
     activation_dtype,
     dequantize_tree,
     params_nbytes,
     prepare_serve_params,
+)
+from deeplearning4j_tpu.serve.speculative import (
+    accept_longest_prefix,
+    resolve_speculative,
 )
 from deeplearning4j_tpu.telemetry import trace as _trace
 from deeplearning4j_tpu.utils.lockwatch import make_condition, make_rlock
@@ -117,6 +163,16 @@ class ServeRequest:
         self.trace_id = None
         self.prefill_ms: float = 0.0
         self.decode_ms: float = 0.0  # sum of decode dispatches it rode
+        # fast-path attribution (ISSUE 16): prefill_ms splits into the
+        # prefix-cache seed time and the suffix/chunk compute time
+        self.prefill_cached_ms: float = 0.0
+        self.prefill_suffix_ms: float = 0.0
+        self.cached_tokens: int = 0     # prefix-cache-seeded positions
+        self.prefill_chunks: int = 0    # chunk dispatches this request ran
+        self.prefill_span = None        # serve.prefill (may span steps)
+        # per-accepted-token arrival stamps (perf_counter seconds) — the
+        # inter-token latency loadgen's p99 reads (chunked-prefill bench)
+        self.t_tokens: List[float] = []
 
     @property
     def latency_s(self) -> Optional[float]:
@@ -138,13 +194,22 @@ class DecodeEngine:
                  serve_dtype: Optional[str] = "bf16",
                  eos_id: Optional[int] = None, seed: int = 0,
                  registry=None, min_bucket: int = 8,
-                 weight_version: Optional[str] = None):
+                 weight_version: Optional[str] = None,
+                 prefix_cache=False, prefix_page_tokens: int = 16,
+                 prefix_cache_pages: int = 256,
+                 prefill_chunk: Optional[int] = None,
+                 speculative=None):
         from deeplearning4j_tpu.telemetry.registry import default_registry
 
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
         if max_len < 2:
             raise ValueError(f"max_len must be >= 2, got {max_len}")
+        if prefill_chunk is not None and not (
+                1 <= int(prefill_chunk) < max_len):
+            raise ValueError(
+                f"prefill_chunk must be in [1, max_len), got "
+                f"{prefill_chunk}")
         self.dims = lm_dims(params)
         self.n_heads = int(n_heads)
         if self.dims["d_model"] % self.n_heads:
@@ -173,6 +238,65 @@ class DecodeEngine:
                                           attn_impl=attn_impl,
                                           params_transform=dequantize_tree)
         self._buckets = self._make_buckets(min_bucket)
+        # --- serving fast path (ISSUE 16), every seam defaulting off ---
+        self.prefill_chunk = (None if prefill_chunk is None
+                              else int(prefill_chunk))
+        if prefix_cache is True:
+            self._prefix = PrefixPageCache(
+                page_tokens=prefix_page_tokens,
+                capacity_pages=prefix_cache_pages,
+                registry=self.registry)
+        else:
+            self._prefix = prefix_cache or None
+        # one chunk executable serves chunked prefill AND the
+        # prefix-cache suffix path (compiles keyed by chunk width)
+        self._chunk = (make_chunk_prefill_step(
+            self.n_heads, self.top_k, params_transform=dequantize_tree)
+            if (self.prefill_chunk is not None or self._prefix is not None)
+            else None)
+        self._chunking: dict = {}       # slot -> pending chunk plan
+        self.spec = resolve_speculative(speculative)
+        if self.spec is not None:
+            if self.spec.k + 1 >= max_len:
+                raise ValueError(
+                    f"speculative k={self.spec.k} needs k+1 < max_len "
+                    f"({max_len})")
+            draft_raw = (self.spec.draft_params
+                         if self.spec.draft_params is not None
+                         else draft_truncate_params(params,
+                                                    self.spec.draft_layers))
+            self._draft_params = prepare_serve_params(draft_raw,
+                                                      serve_dtype)
+            self._draft_cache = init_kv_cache(
+                lm_dims(draft_raw)["n_layers"], self.n_slots,
+                self.n_heads, head_dim, self.max_len,
+                dtype=activation_dtype(serve_dtype))
+            self._draft_decode = make_decode_step(
+                self.n_heads, self.top_k,
+                params_transform=dequantize_tree)
+            self._draft_prefill = make_prefill_step(
+                self.n_heads, self.top_k, attn_impl=attn_impl,
+                params_transform=dequantize_tree)
+            self._verify = make_verify_step(
+                self.n_heads, self.top_k,
+                params_transform=dequantize_tree)
+        self.spec_verify_steps = 0
+        self.spec_accepted_total = 0
+        self._spec_proposed_total = 0
+        # the counter the full-prefix-hit pin asserts against exists (at
+        # 0) from construction; spec instruments likewise when armed
+        self.registry.counter("serve_prefill_dispatches_total")
+        if self.spec is not None:
+            for name in ("serve_spec_verify_steps_total",
+                         "serve_spec_accepted_tokens_total",
+                         "serve_spec_draft_prefills_total",
+                         "serve_spec_draft_steps_total"):
+                self.registry.counter(name)
+            self.registry.histogram("serve_spec_accepted_per_verify")
+            self.registry.histogram("serve_verify_step_ms")
+            # serve_spec_accept_rate stays UNBORN until the warmup floor
+            # of verify steps: the serve_spec_accept_collapse rule
+            # (op "<") must read "not yet speculating" as no-data
         self._key = jax.random.PRNGKey(seed)
         # the lockwatch seam (ISSUE 11): plain primitives unless the
         # watch is armed (lockwatch fixture / DL4J_TPU_LOCKWATCH=1)
@@ -336,34 +460,169 @@ class DecodeEngine:
 
     def _admit(self, req: ServeRequest, slot: int) -> None:
         n = len(req.prompt)
-        bucket = self.bucket_for(n)
         if req.queue_span is not None:
             req.queue_span.end()
             req.queue_span = None
         req.t_admit = time.perf_counter()
-        prefill_span = (req.span.tracer.start_span(
+        req.slot = slot
+        self._slots[slot] = req
+        self._temps[slot] = req.temperature
+        # ---- prefix-cache lookup + slot seed (zero flagship compute) ----
+        plen = 0
+        if self._prefix is not None:
+            t0 = time.perf_counter()
+            plen, k_pages, v_pages = self._prefix.lookup(req.prompt)
+            if plen:
+                kcat = (k_pages[0] if len(k_pages) == 1
+                        else jnp.concatenate(k_pages, axis=2))
+                vcat = (v_pages[0] if len(v_pages) == 1
+                        else jnp.concatenate(v_pages, axis=2))
+                ck, cv = seed_slot_pages(self._cache["k"],
+                                         self._cache["v"], kcat, vcat,
+                                         np.int32(slot))
+                self._cache = {"k": ck, "v": cv}
+                req.prefill_cached_ms = (time.perf_counter() - t0) * 1000.0  # graftlint: allow[untimed-dispatch] attribution stamp, not a benchmark — syncing here would stall the scheduler hot path; the seed's cost is fenced by the decode step that consumes the cache
+                req.prefill_ms += req.prefill_cached_ms
+            req.cached_tokens = plen
+        req.prefill_span = (req.span.tracer.start_span(
             "serve.prefill", parent=req.span,
-            attrs={"slot": slot, "bucket": bucket, "prompt_len": n})
+            attrs={"slot": slot, "prompt_len": n, "cached_tokens": plen})
             if req.span is not None else None)
+        if self.spec is not None:
+            self._draft_admit(req, slot, n)
+        # ---- full hit: the cached prefix covers every position the last
+        # prompt token's decode tick doesn't write itself — NO flagship
+        # prefill dispatch; the first token arrives from the shared
+        # decode step, exactly as if prefill had just run ----
+        if plen >= n - 1:
+            self._tokens[slot] = req.prompt[-1]
+            self._positions[slot] = n - 1
+            self._finish_prefill_span(req, mode="cached_full")
+            if req.span is not None:
+                req.decode_span = req.span.tracer.start_span(
+                    "serve.decode", parent=req.span, attrs={"slot": slot})
+            return
+        # ---- chunked path: long prompts (or any cached-prefix suffix)
+        # run through the chunk executable; interleaved one chunk per
+        # scheduler iteration when prefill_chunk is configured ----
+        if self._chunk is not None and (
+                plen > 0 or (self.prefill_chunk is not None
+                             and n > self.prefill_chunk)):
+            plan = self._chunk_plan(req, plen)
+            if self.prefill_chunk is not None and len(plan) > 1:
+                # garbage-write shield: the shared decode tick writes this
+                # slot at _positions — point it where the next chunk will
+                # overwrite before any query can read it
+                self._positions[slot] = plan[0][1]
+                self._chunking[slot] = {"req": req, "plan": plan,
+                                        "idx": 0}
+                return
+            for idx in range(len(plan)):
+                self._run_chunk(req, slot, plan, idx)
+            return
+        # ---- classic one-shot bucketed prefill ----
+        bucket = self.bucket_for(n)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :n] = req.prompt
+        if req.prefill_span is not None:
+            req.prefill_span.set_attr("bucket", bucket)
         t0 = time.perf_counter()
         self._cache, tok = self._prefill(
             self.params, self._cache, padded, n - 1, slot,
             np.float32(req.temperature), self._key, self._step_idx)
         self._step_idx += 1
+        self.registry.counter("serve_prefill_dispatches_total").inc()
         tok = int(np.asarray(tok))  # graftlint: allow[blocking-under-lock] deliberate: the scheduler lock IS the serialization — slot state may only change together with the fenced prefill result
         now = time.perf_counter()
-        req.prefill_ms = (now - t0) * 1000.0
-        if prefill_span is not None:
-            prefill_span.end()
+        req.prefill_suffix_ms += (now - t0) * 1000.0
+        req.prefill_ms += (now - t0) * 1000.0
+        self._complete_prefill(req, slot, tok, now, mode="full")
+
+    def _draft_admit(self, req: ServeRequest, slot: int, n: int) -> None:
+        """Seed the DRAFT cache for an admitted slot (speculative only):
+        one draft-prefill dispatch over the full prompt. Counted apart
+        from ``serve_prefill_dispatches_total`` — the full-hit pin is
+        about flagship work; the draft is the cost of speculation."""
+        bucket = self.bucket_for(n)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = req.prompt
+        self._draft_cache, _ = self._draft_prefill(
+            self._draft_params, self._draft_cache, padded, n - 1, slot,
+            np.float32(0.0), self._key, self._step_idx)
+        self._step_idx += 1
+        self.registry.counter("serve_spec_draft_prefills_total").inc()
+
+    def _chunk_plan(self, req: ServeRequest, plen: int) -> list:
+        """Chunk schedule covering prompt positions [plen, n): a list of
+        ``(tokens (1, W) np.int32, start, last_idx)``. Interleaved mode
+        (prefill_chunk set, suffix > chunk) uses W = prefill_chunk with
+        the FINAL chunk shifted left to ``n - W`` (same shape, overlap
+        rewrites identical values); the prefix-suffix one-shot uses one
+        bucket-width chunk. Every start satisfies start + W <= max_len,
+        so the in-graph dynamic write can never clamp onto live
+        positions."""
+        n = len(req.prompt)
+        C = self.prefill_chunk
+        if C is not None and n - plen > C:
+            starts = list(range(plen, n - C, C))
+            starts.append(n - C)
+            width = C
+        else:
+            width = min(self.bucket_for(n - plen), self.max_len)
+            starts = [max(0, n - width)]
+        plan = []
+        for i, s in enumerate(starts):
+            toks = np.zeros((1, width), np.int32)
+            real = req.prompt[s:min(s + width, n)]
+            toks[0, :len(real)] = real
+            last_idx = (n - 1 - s) if i == len(starts) - 1 else width - 1
+            plan.append((toks, s, last_idx))
+        return plan
+
+    def _run_chunk(self, req: ServeRequest, slot: int, plan: list,
+                   idx: int) -> None:
+        """Dispatch chunk ``idx``; on the final chunk, complete the
+        admission with its sampled first token."""
+        toks, start, last_idx = plan[idx]
+        final = idx == len(plan) - 1
+        t0 = time.perf_counter()
+        self._cache, tok = self._chunk(
+            self.params, self._cache, toks, np.int32(start),
+            np.int32(last_idx), np.int32(slot),
+            np.float32(req.temperature), self._key, self._step_idx)
+        self._step_idx += 1
+        self.registry.counter("serve_prefill_dispatches_total").inc()
+        req.prefill_chunks += 1
+        if final:
+            tok = int(np.asarray(tok))  # graftlint: allow[blocking-under-lock] deliberate: same fencing contract as the classic prefill — slot state changes only with the fenced result
+        now = time.perf_counter()
+        req.prefill_suffix_ms += (now - t0) * 1000.0
+        req.prefill_ms += (now - t0) * 1000.0
+        if final:
+            self._chunking.pop(slot, None)
+            self._complete_prefill(
+                req, slot, tok, now,
+                mode="suffix" if req.cached_tokens else "chunked")
+        else:
+            # shield: next chunk overwrites [next_start, next_start + W)
+            self._positions[slot] = plan[idx + 1][1]
+
+    def _complete_prefill(self, req: ServeRequest, slot: int, tok: int,
+                          now: float, mode: str) -> None:
+        """Prompt K/V fully resident: publish pages to the prefix cache,
+        arm decode state, accept the first token."""
+        if self._prefix is not None:
+            n_pages = len(req.prompt) // self._prefix.page_tokens
+            if n_pages:
+                span = n_pages * self._prefix.page_tokens
+                self._prefix.insert(
+                    req.prompt,
+                    self._cache["k"][:, slot, :, :span, :],
+                    self._cache["v"][:, slot, :, :span, :])
         self.registry.histogram("serve_prefill_ms").observe(
-            (now - t0) * 1000.0, exemplar=req.trace_id)
-        req.slot = slot
-        req.t_first = now
-        self._slots[slot] = req
-        self._positions[slot] = n
-        self._temps[slot] = req.temperature
+            req.prefill_ms, exemplar=req.trace_id)
+        self._finish_prefill_span(req, mode=mode)
+        self._positions[slot] = len(req.prompt)
         if req.span is not None:
             # started BEFORE the first accept: max_new_tokens=1 / instant
             # EOS retire the request inside this very call
@@ -371,13 +630,31 @@ class DecodeEngine:
                 "serve.decode", parent=req.span, attrs={"slot": slot})
         self._accept_token(req, tok, now)
 
+    def _finish_prefill_span(self, req: ServeRequest, mode: str) -> None:
+        if req.prefill_span is None:
+            return
+        req.prefill_span.set_attr("mode", mode)
+        req.prefill_span.set_attr("cached_tokens", req.cached_tokens)
+        req.prefill_span.set_attr("chunks", req.prefill_chunks)
+        req.prefill_span.set_attr("cached_ms",
+                                  round(req.prefill_cached_ms, 3))
+        req.prefill_span.set_attr("suffix_ms",
+                                  round(req.prefill_suffix_ms, 3))
+        req.prefill_span.end()
+        req.prefill_span = None
+
     def _accept_token(self, req: ServeRequest, tok: int, now: float) -> None:
         """Record one sampled token for ``req`` and retire it at EOS /
         max_new_tokens / cache exhaustion (iteration-level eviction)."""
+        if req.t_first is None:
+            # stamped at the first accepted token — for the prefix-cache
+            # full-hit path that is the shared decode tick, not a prefill
+            req.t_first = now
         if req.eos_id is not None and tok == req.eos_id:
             self._finish(req, "eos", now)
             return
         req.generated.append(tok)
+        req.t_tokens.append(now)
         if req.decode_span is not None:
             req.decode_span.add_event("accept", token=tok,
                                       n=len(req.generated))
@@ -414,6 +691,14 @@ class DecodeEngine:
             latency_ms = (now - req.t_submit) * 1000.0
             req.span.set_attr("queue_wait_ms", round(queue_ms, 3))
             req.span.set_attr("prefill_ms", round(req.prefill_ms, 3))
+            # fast-path split (ISSUE 16): prefill_ms = cached-skip (page
+            # seed) + suffix-prefill (chunk/classic compute) — what
+            # tools/trace_report.py's serve attribution tables
+            req.span.set_attr("prefill_cached_ms",
+                              round(req.prefill_cached_ms, 3))
+            req.span.set_attr("prefill_suffix_ms",
+                              round(req.prefill_suffix_ms, 3))
+            req.span.set_attr("cached_tokens", req.cached_tokens)
             req.span.set_attr("decode_ms", round(req.decode_ms, 3))
             req.span.set_attr("gap_ms", round(
                 latency_ms - queue_ms - req.prefill_ms - req.decode_ms, 3))
@@ -464,34 +749,56 @@ class DecodeEngine:
                 admitted += 1
             self.registry.gauge("serve_queue_depth").set(
                 float(len(self._queue)))
-            active = [r for r in self._slots if r is not None]
+            # ---- chunked prefill: ONE chunk per mid-prefill slot per
+            # iteration, so a long admission interleaves with decode
+            # ticks instead of head-of-line-blocking them ----
+            for slot in list(self._chunking):
+                st = self._chunking[slot]
+                self._run_chunk(st["req"], slot, st["plan"], st["idx"])
+                if slot in self._chunking:
+                    st["idx"] += 1
+            active = [r for r in self._slots
+                      if r is not None and r.slot not in self._chunking]
             self.registry.gauge("serve_active_slots").set(
                 float(len(active)))
             if not active:
                 if step_span is not None:
                     step_span.set_attr("admissions", admitted)
                     step_span.set_attr("occupancy", 0)
-                    step_span.set_attr("idle", True)
+                    step_span.set_attr("idle", not self._chunking)
                     step_span.end()
                 return self.tokens_total - tokens_before
-            t0 = time.perf_counter()
-            self._cache, toks = self._decode(
-                self.params, self._cache, self._tokens, self._positions,
-                self._temps, self._key, self._step_idx)
-            self._step_idx += 1
-            toks = np.asarray(toks)  # graftlint: allow[blocking-under-lock] deliberate: retirement must see the fenced decode tokens; submit() blocks here only between decode steps
-            now = time.perf_counter()
-            decode_ms = (now - t0) * 1000.0
-            self.registry.histogram("serve_decode_step_ms").observe(
-                decode_ms)
-            self.decode_steps += 1
-            self._occupancy_sum += len(active)
-            for req in active:
-                slot = req.slot
-                if req.decode_span is not None:
-                    req.decode_ms += decode_ms
-                self._positions[slot] += 1
-                self._accept_token(req, int(toks[slot]), now)
+            # ---- speculative eligibility: the verify dispatch writes
+            # k+1 positions per slot; near the page end (or while a slot
+            # is mid-chunk-prefill) fall back to the plain decode tick —
+            # dynamic_update_slice clamps out-of-range starts, which
+            # would silently overwrite live earlier positions ----
+            spec_tick = (
+                self.spec is not None and not self._chunking
+                and all(int(self._positions[r.slot]) + self.spec.k + 1
+                        <= self.max_len for r in active))
+            if spec_tick:
+                decode_ms = self._spec_step(active, step_span)
+            else:
+                t0 = time.perf_counter()
+                self._cache, toks = self._decode(
+                    self.params, self._cache, self._tokens,
+                    self._positions, self._temps, self._key,
+                    self._step_idx)
+                self._step_idx += 1
+                toks = np.asarray(toks)  # graftlint: allow[blocking-under-lock] deliberate: retirement must see the fenced decode tokens; submit() blocks here only between decode steps
+                now = time.perf_counter()
+                decode_ms = (now - t0) * 1000.0
+                self.registry.histogram("serve_decode_step_ms").observe(
+                    decode_ms)
+                self.decode_steps += 1
+                self._occupancy_sum += len(active)
+                for req in active:
+                    slot = req.slot
+                    if req.decode_span is not None:
+                        req.decode_ms += decode_ms
+                    self._positions[slot] += 1
+                    self._accept_token(req, int(toks[slot]), now)
             occupancy_after = sum(r is not None for r in self._slots)
             self.registry.gauge("serve_active_slots").set(
                 float(occupancy_after))
@@ -504,6 +811,89 @@ class DecodeEngine:
                 step_span.set_attr("decode_ms", round(decode_ms, 3))
                 step_span.end()
             return self.tokens_total - tokens_before
+
+    def _spec_step(self, active: List[ServeRequest], step_span) -> float:
+        """One speculative iteration (called under the scheduler lock):
+        k draft decode dispatches propose, ONE flagship verify dispatch
+        of width k+1 checks, the host accepts the longest matching
+        prefix + the flagship's bonus token per slot. Greedy slots emit
+        1..k+1 tokens per flagship dispatch and the stream is exactly
+        the non-speculative one; sampling slots accept only position 0's
+        sampled token (distribution-correct, no speedup)."""
+        k = self.spec.k
+        t0 = time.perf_counter()
+        drafts = np.zeros((self.n_slots, k), np.int32)
+        cur = self._tokens.copy()
+        dpos = self._positions.copy()
+        # k+1 dispatches, not k: the extra one writes the LAST proposal's
+        # K/V into the draft cache, so a fully-accepted round leaves no
+        # hole at position p+k when the next round starts from p+k+1
+        # (the final dispatch's proposal is discarded). Eligibility
+        # (p + k + 1 <= max_len) bounds every write.
+        for j in range(k + 1):
+            self._draft_cache, dt = self._draft_decode(
+                self._draft_params, self._draft_cache, cur, dpos,
+                self._temps, self._key, self._step_idx)
+            self._step_idx += 1
+            if j < k:
+                dt = np.asarray(dt)  # graftlint: allow[blocking-under-lock] deliberate: proposal j+1 feeds on proposal j; the scheduler lock is the serialization
+                drafts[:, j] = dt
+                cur = dt.copy()
+            dpos += 1
+        self.registry.counter("serve_spec_draft_steps_total").inc(k + 1)
+        t1 = time.perf_counter()
+        vt = np.concatenate([self._tokens[:, None], drafts], axis=1)
+        self._cache, vtoks = self._verify(
+            self.params, self._cache, vt, self._positions, self._temps,
+            self._key, self._step_idx)
+        self._step_idx += 1
+        vtoks = np.asarray(vtoks)  # graftlint: allow[blocking-under-lock] deliberate: acceptance must see the fenced verify tokens, exactly like the decode tick
+        now = time.perf_counter()
+        draft_ms = (t1 - t0) * 1000.0
+        verify_ms = (now - t1) * 1000.0
+        # trace exemplar on the verify latency observation (ISSUE 16):
+        # a slow verify is attributable to a real request's trace
+        self.registry.histogram("serve_verify_step_ms").observe(
+            verify_ms, exemplar=active[0].trace_id)
+        self.registry.histogram("serve_decode_step_ms").observe(
+            draft_ms + verify_ms)
+        self.registry.counter("serve_spec_verify_steps_total").inc()
+        self.spec_verify_steps += 1
+        self.decode_steps += 1
+        self._occupancy_sum += len(active)
+        for req in active:
+            slot = req.slot
+            p = int(self._positions[slot])
+            if req.temperature > 0:
+                a, emitted = 0, [int(vtoks[slot, 0])]
+            else:
+                a, emitted = accept_longest_prefix(drafts[slot],
+                                                   vtoks[slot])
+            self.spec_accepted_total += a
+            self._spec_proposed_total += k
+            self.registry.counter(
+                "serve_spec_accepted_tokens_total").inc(a)
+            self.registry.histogram(
+                "serve_spec_accepted_per_verify").observe(
+                float(a), exemplar=req.trace_id)
+            if req.decode_span is not None:
+                req.decode_ms += draft_ms + verify_ms
+                req.decode_span.add_event("verify", accepted=a,
+                                          proposed=k,
+                                          emitted=len(emitted))
+            for j, tok in enumerate(emitted):
+                self._positions[slot] = p + j + 1
+                self._accept_token(req, tok, now)
+                if req.done.is_set():
+                    break  # retired mid-run; trailing tokens discarded
+        if self.spec_verify_steps >= 8:
+            self.registry.gauge("serve_spec_accept_rate").set(
+                self.spec_accepted_total
+                / max(1, self._spec_proposed_total))
+        if step_span is not None:
+            step_span.set_attr("speculative", True)
+            step_span.set_attr("draft_ms", round(draft_ms, 3))
+        return draft_ms + verify_ms
 
     def run_until_idle(self, max_steps: int = 100_000) -> int:
         """Drive ``step`` until queue and slots drain; returns tokens."""
@@ -610,6 +1000,18 @@ class DecodeEngine:
                 "tokens_per_sec": (self.tokens_total / elapsed
                                    if elapsed > 0 else 0.0),
                 "in_flight": in_flight,
+                "prefill_chunk": self.prefill_chunk,
+                "chunking_slots": len(self._chunking),
+                "prefix_cache": (self._prefix.stats()
+                                 if self._prefix is not None else None),
+                "speculative": ({
+                    "k": self.spec.k,
+                    "verify_steps": self.spec_verify_steps,
+                    "accepted_tokens": self.spec_accepted_total,
+                    "accept_rate": (
+                        self.spec_accepted_total
+                        / max(1, self._spec_proposed_total)),
+                } if self.spec is not None else None),
                 "model": dict(self.dims, n_heads=self.n_heads,
                               top_k=self.top_k),
             }

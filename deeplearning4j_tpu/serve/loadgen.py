@@ -70,6 +70,13 @@ class LoadReport:
     slo_ms: Optional[float] = None
     goodput_rps: Optional[float] = None
     slo_attainment: Optional[float] = None  # fraction within SLO
+    # decode-token inter-arrival percentiles (ISSUE 16): the gap between
+    # consecutive accepted tokens WITHIN a request, pooled across
+    # requests — the stream-smoothness number chunked prefill exists to
+    # protect (a monolithic long-prompt prefill shows up as a p99 spike
+    # here long before it moves full-request latency)
+    inter_token_p50_ms: Optional[float] = None
+    inter_token_p99_ms: Optional[float] = None
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -138,7 +145,7 @@ def run_open_loop(engine, prompts: Sequence[Sequence[int]],
         elif pending:
             time.sleep(min(0.002, t0 + pending[0][0] - now))
     t_end = time.perf_counter()
-    lat, first = [], []
+    lat, first, gaps = [], [], []
     tokens = 0
     done = 0
     for arrival, req in requests:
@@ -149,8 +156,12 @@ def run_open_loop(engine, prompts: Sequence[Sequence[int]],
         lat.append((req.t_done - arrival) * 1000.0)
         if req.t_first is not None:
             first.append((req.t_first - arrival) * 1000.0)
+        stamps = getattr(req, "t_tokens", [])
+        gaps.extend((b - a) * 1000.0
+                    for a, b in zip(stamps, stamps[1:]))
     p50, p95, p99, mean = _percentiles(lat)
     ft = _percentiles(first) if first else None
+    it = _percentiles(gaps) if gaps else None
     duration = t_end - t0
     goodput_rps, attainment = _goodput(lat, slo_ms, duration)
     return LoadReport(
@@ -162,7 +173,9 @@ def run_open_loop(engine, prompts: Sequence[Sequence[int]],
         first_token_p50_ms=ft[0] if ft else None,
         first_token_p99_ms=ft[2] if ft else None,
         slo_ms=slo_ms, goodput_rps=goodput_rps,
-        slo_attainment=attainment)
+        slo_attainment=attainment,
+        inter_token_p50_ms=it[0] if it else None,
+        inter_token_p99_ms=it[2] if it else None)
 
 
 def run_open_loop_http(base_url: str, prompts: Sequence[Sequence[int]],

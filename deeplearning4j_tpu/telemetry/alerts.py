@@ -279,7 +279,16 @@ class AlertEngine:
                     self.registry.histogram(r.metric)
                 elif r.kind == "threshold" or (r.kind == "rate"
                                                and r.use_delta):
-                    self.registry.gauge(r.metric)
+                    # EXCEPT where the pre-armed default (0.0) would
+                    # itself satisfy the rule (op "<" on a ratio gauge,
+                    # e.g. serve_prefix_cache_hit_rate): creating the
+                    # instrument would turn "subsystem never ran" into
+                    # a page — those gauges stay unborn until their
+                    # subsystem emits a real value, and no-data stays
+                    # inactive.
+                    if not (r.kind == "threshold"
+                            and _OPS[r.op](0.0, r.threshold)):
+                        self.registry.gauge(r.metric)
                 elif r.kind == "rate":
                     self.registry.counter(r.metric)
 
@@ -507,6 +516,17 @@ def default_rules() -> List[AlertRule]:
       locks are contending far above the ambient rate.
     - ``cluster_stale_process`` — federation (PR 12): an aggregator sees
       a pusher whose snapshots lapsed (the cluster-level heartbeat).
+    - ``serve_cache_hit_rate_low`` — serving fast path (ISSUE 16): the
+      prefix page cache is enabled but barely hitting — either traffic
+      shares no prefixes (turn it off) or capacity is churning hot
+      chains out. The gauge is born on the first lookup, so an engine
+      without the cache (or without traffic) stays inactive.
+    - ``serve_spec_accept_collapse`` — serving fast path (ISSUE 16):
+      the draft LM's proposals stopped matching the flagship —
+      speculation is burning k draft steps per verify for ~nothing
+      (stale draft after a weight swap, or a draft too weak for the
+      traffic). The gauge is born after the engine's warmup floor of
+      verify steps, so startup noise can't page.
     """
     return [
         AlertRule(
@@ -559,6 +579,22 @@ def default_rules() -> List[AlertRule]:
             for_s=0.0, severity="warning",
             description="a federated process's metric pushes lapsed "
                         "(cluster-level heartbeat)"),
+        AlertRule(
+            name="serve_cache_hit_rate_low", kind="threshold",
+            metric="serve_prefix_cache_hit_rate", threshold=0.1,
+            op="<", for_s=60.0, severity="warning",
+            description="the serve prefix page cache is hitting on "
+                        "<10% of lookups sustained — no prefix "
+                        "sharing in traffic, or hot chains are being "
+                        "evicted"),
+        AlertRule(
+            name="serve_spec_accept_collapse", kind="threshold",
+            metric="serve_spec_accept_rate", threshold=0.1,
+            op="<", for_s=60.0, severity="warning",
+            description="speculative-decode draft acceptance "
+                        "collapsed below 10% — draft proposals no "
+                        "longer track the flagship, verify dispatches "
+                        "are wasted"),
     ]
 
 

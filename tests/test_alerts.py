@@ -139,6 +139,16 @@ RULE_FIXTURES = {
         lambda: _two_sample_gauge("federation_stale_processes", 1, 1),
         lambda: _two_sample_gauge("federation_stale_processes", 0, 0),
     ),
+    "serve_cache_hit_rate_low": (
+        lambda: _two_sample_gauge("serve_prefix_cache_hit_rate",
+                                  0.02, 0.02),
+        lambda: _two_sample_gauge("serve_prefix_cache_hit_rate",
+                                  0.8, 0.8),
+    ),
+    "serve_spec_accept_collapse": (
+        lambda: _two_sample_gauge("serve_spec_accept_rate", 0.01, 0.01),
+        lambda: _two_sample_gauge("serve_spec_accept_rate", 0.6, 0.6),
+    ),
 }
 
 
@@ -198,6 +208,24 @@ class TestDefaultRulePack:
         eng = AlertEngine(h, registry=MetricsRegistry())
         for st in eng.evaluate_once(now=T0, publish=False):
             assert st["state"] == "inactive", st
+
+    def test_low_op_rules_not_prearmed_into_firing(self):
+        """The pre-arm trap the ISSUE 16 ratio rules must dodge: with
+        engine and history SHARING one registry (the arm_watchtower
+        wiring), pre-arming a "<"-op gauge at 0.0 would make every idle
+        process page hit-rate-low/accept-collapse. Those gauges must
+        stay unborn until their subsystem emits, and the rules
+        inactive."""
+        reg = MetricsRegistry()
+        h = MetricsHistory(registry=reg)
+        eng = AlertEngine(h, registry=reg)
+        h.sample_once(now=T0)
+        eng.evaluate_once(now=T0, publish=False)
+        h.sample_once(now=T0 + 120.0)
+        for st in eng.evaluate_once(now=T0 + 120.0, publish=False):
+            if st["rule"] in ("serve_cache_hit_rate_low",
+                              "serve_spec_accept_collapse"):
+                assert st["state"] == "inactive", st
 
 
 class TestRuleValidation:

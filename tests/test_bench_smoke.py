@@ -484,7 +484,7 @@ def test_bench_observability_stage_on_cpu():
     assert hist["series"] > 0
     assert hist["serve_tokens_rate_per_s"] > 0   # live rate query worked
     al = sd["alerts"]
-    assert al["rules"] == 8
+    assert al["rules"] == 10  # default pack incl. the ISSUE 16 serve rules
     # a healthy run pages nobody
     assert al["quiet_run_firing"] == []
     # the injected-fault demo fired BOTH demo rules deterministically...
@@ -620,6 +620,27 @@ def test_bench_optimizer_stage_on_cpu():
     # identical math: sharded and replicated agree after 3 steps
     assert sd["adam_sharded_vs_replicated_parity_max_abs_diff"] <= 1e-5
     assert sd["adam_loss_delta"] <= 1e-5
+
+
+def test_bench_ref_micro_stage_on_cpu():
+    """ISSUE 16: the machine-noise reference stage runs end to end on the
+    CPU backend and reports a positive rate under the standard
+    samples_per_sec key — tools/bench_report.py keys its round-over-round
+    normalization off this row, so the stage silently dying would turn
+    every future delta back into raw (unnormalized) noise."""
+    env = dict(os.environ)
+    env["BENCH_FORCE_CPU"] = "1"
+    env["BENCH_FAST"] = "1"
+    env["BENCH_BUDGET_SEC"] = "60"
+    env["BENCH_ONLY"] = "ref_micro"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=120, cwd=REPO, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    det = json.loads(out.stdout.strip().splitlines()[-1])["detail"]
+    assert det.get("ref_micro_samples_per_sec", 0) > 0, det.get(
+        "ref_micro_status")
 
 
 # ------------------------------------------------ stage-coverage meta-test ----

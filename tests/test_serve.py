@@ -27,10 +27,14 @@ from deeplearning4j_tpu.models.transformer_lm import (
 from deeplearning4j_tpu.ops.flash_attention import attention_core
 from deeplearning4j_tpu.serve import (
     DecodeEngine,
+    PrefixPageCache,
     QuantTensor,
+    SpeculativeConfig,
+    accept_longest_prefix,
     arrival_schedule,
     params_nbytes,
     prepare_serve_params,
+    resolve_speculative,
     run_open_loop,
 )
 
@@ -298,6 +302,27 @@ def test_open_loop_drives_engine_to_completion(params):
     assert d["slo_ms"] is None and d["goodput_rps"] is None
 
 
+def test_open_loop_inter_token_percentiles(params):
+    """ISSUE 16: the report carries decode-token inter-arrival
+    percentiles (gaps between consecutive tokens within a request) — the
+    stream-smoothness number the chunked-prefill twin is measured on."""
+    eng = DecodeEngine(params, H, n_slots=2, max_len=MAXLEN,
+                       serve_dtype=None)
+    eng.generate([1] * 5, max_new_tokens=2)  # warm
+    rep = run_open_loop(eng, _prompts(4, seed=19), rate_rps=300.0,
+                        max_new_tokens=4)
+    assert rep.completed == 4
+    assert rep.inter_token_p99_ms >= rep.inter_token_p50_ms > 0
+    d = rep.to_dict()
+    assert d["inter_token_p50_ms"] == rep.inter_token_p50_ms
+    # single-token requests produce no gaps: fields stay None
+    eng2 = DecodeEngine(params, H, n_slots=2, max_len=MAXLEN,
+                        serve_dtype=None)
+    rep1 = run_open_loop(eng2, _prompts(2, seed=20), rate_rps=300.0,
+                         max_new_tokens=1)
+    assert rep1.inter_token_p50_ms is None
+
+
 def test_open_loop_goodput_under_slo(params):
     """ISSUE 15 satellite: ``slo_ms`` turns the open-loop run into a
     goodput measurement — requests completing WITHIN the SLO per second,
@@ -381,14 +406,19 @@ def test_from_checkpoint_rejects_non_lm_tree(tmp_path):
 
 # ------------------------------------------- bench_report latency rows ----
 
-def _bench_round(path, p95_ms, tokens_per_sec):
+def _bench_round(path, p95_ms, tokens_per_sec, ref=None, fast_path=None):
+    detail = {
+        "serve_tokens_per_sec": tokens_per_sec,
+        "serve_detail": {"latency": {"p50_ms": p95_ms / 2,
+                                     "p95_ms": p95_ms,
+                                     "mean_ms": p95_ms / 2}},
+    }
+    if ref is not None:  # the ISSUE 16 fixed reference micro-stage row
+        detail["ref_micro_samples_per_sec"] = ref
+    if fast_path is not None:
+        detail["serve_detail"]["fast_path"] = fast_path
     rec = {"n": 1, "cmd": "bench", "rc": 0, "tail": "", "parsed": {
-        "metric": "m", "value": 1.0, "detail": {
-            "serve_tokens_per_sec": tokens_per_sec,
-            "serve_detail": {"latency": {"p50_ms": p95_ms / 2,
-                                         "p95_ms": p95_ms,
-                                         "mean_ms": p95_ms / 2}},
-        }}}
+        "metric": "m", "value": 1.0, "detail": detail}}
     with open(path, "w") as fh:
         json.dump(rec, fh)
 
@@ -430,6 +460,115 @@ def test_bench_report_latency_improvement_not_flagged(tmp_path):
     traj = build_trajectory(load_rounds(str(tmp_path)), threshold_pct=10.0)
     rows = {r["metric"]: r for r in traj["table"]}
     assert rows["serve_latency_p95_ms"]["regression"] is False
+
+
+# ----------------------------------- bench_report noise carry-over rows ----
+
+def _traj(tmp_path, threshold_pct=10.0):
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tools.bench_report import build_trajectory, load_rounds
+
+    return build_trajectory(load_rounds(str(tmp_path)),
+                            threshold_pct=threshold_pct)
+
+
+def test_bench_report_ref_unmasks_regression_on_faster_machine(tmp_path):
+    """ISSUE 16 satellite, direction 1: the bench box got 5% FASTER
+    (ref 100 -> 105) while the tracked rate only dropped 7.6% raw —
+    under the old raw delta that hides a real regression (the machine
+    speedup masks part of the code slowdown). Normalized by the
+    reference drift the true delta is -12%, past the gate."""
+    _bench_round(tmp_path / "BENCH_r01.json", p95_ms=10.0,
+                 tokens_per_sec=100.0, ref=100.0)
+    _bench_round(tmp_path / "BENCH_r02.json", p95_ms=10.0,
+                 tokens_per_sec=92.4, ref=105.0)
+    traj = _traj(tmp_path)
+    row = {r["metric"]: r for r in traj["table"]}["serve_tokens_per_sec"]
+    assert row["ref_factor"] == 1.05
+    assert row["delta_pct"] == -12.0
+    assert row["regression"] is True
+    assert not traj["ref_flags"]
+
+
+def test_bench_report_ref_absorbs_machine_slowdown(tmp_path):
+    """Direction 2: the box got 5% SLOWER (ref 100 -> 95); the tracked
+    rate's raw -12% would false-flag, but dividing the drift out leaves
+    -7.4% — under the gate, no regression."""
+    _bench_round(tmp_path / "BENCH_r01.json", p95_ms=10.0,
+                 tokens_per_sec=100.0, ref=100.0)
+    _bench_round(tmp_path / "BENCH_r02.json", p95_ms=10.0,
+                 tokens_per_sec=88.0, ref=95.0)
+    traj = _traj(tmp_path)
+    row = {r["metric"]: r for r in traj["table"]}["serve_tokens_per_sec"]
+    assert row["ref_factor"] == 0.95
+    assert -8.0 < row["delta_pct"] < -7.0
+    assert row["regression"] is False
+
+
+def test_bench_report_ref_drift_flags_round_and_suppresses(tmp_path):
+    """A reference that itself moved >10% is a broken reference —
+    normalizing by it would hide real regressions, so the pair is
+    flagged, deltas stay raw, and gating is suppressed (REF-NOISE, not
+    REGRESSION: a round this noisy can't distinguish code from box)."""
+    _bench_round(tmp_path / "BENCH_r01.json", p95_ms=10.0,
+                 tokens_per_sec=100.0, ref=100.0)
+    _bench_round(tmp_path / "BENCH_r02.json", p95_ms=10.0,
+                 tokens_per_sec=85.0, ref=80.0)
+    traj = _traj(tmp_path)
+    from tools.bench_report import render_text
+    row = {r["metric"]: r for r in traj["table"]}["serve_tokens_per_sec"]
+    assert row["regression"] is False
+    assert row["suppressed_by_ref"] is True
+    assert row["delta_pct"] == -15.0  # raw, NOT normalized by 0.8
+    assert traj["ref_flags"] == [
+        {"from_round": 1, "to_round": 2, "ref_factor": 0.8}]
+    text = render_text(traj)
+    assert "REF-NOISE" in text
+    assert "drifted past the stability window" in text
+
+
+def test_bench_report_ref_row_itself_never_gates(tmp_path):
+    """The reference halving is the MACHINE halving — it must flag the
+    pair, never read as a code regression on its own row (and rounds
+    without the row at all keep the old raw behavior, covered by the
+    latency tests above)."""
+    _bench_round(tmp_path / "BENCH_r01.json", p95_ms=10.0,
+                 tokens_per_sec=100.0, ref=100.0)
+    _bench_round(tmp_path / "BENCH_r02.json", p95_ms=10.0,
+                 tokens_per_sec=100.0, ref=50.0)
+    traj = _traj(tmp_path)
+    rows = {r["metric"]: r for r in traj["table"]}
+    assert rows["ref_micro_samples_per_sec"]["regression"] is False
+    assert rows["ref_micro_samples_per_sec"]["ref_factor"] is None
+    assert len(traj["ref_flags"]) == 1
+
+
+def test_bench_report_fastpath_rows_tracked_both_directions(tmp_path):
+    """ISSUE 16 satellite: the serve fast-path twin block lands as
+    tracked rows — ratio/quality rows HIGHER-IS-BETTER (an eroding
+    prefix-cache win gates), the inter-token p99s LOWER-IS-BETTER (a
+    chunk-scheduling change that re-introduces the stream stall
+    gates)."""
+    fp1 = {"prefix_on_vs_off": 2.0, "spec_on_vs_off": 1.1,
+           "chunk_vs_unchunked": 0.97, "cache_hit_rate": 0.9,
+           "accepted_per_verify": 1.5, "inter_token_p99_ms_chunked": 5.0,
+           "inter_token_p99_ms_unchunked": 20.0}
+    fp2 = dict(fp1, prefix_on_vs_off=1.2, inter_token_p99_ms_chunked=9.0)
+    _bench_round(tmp_path / "BENCH_r01.json", p95_ms=10.0,
+                 tokens_per_sec=100.0, fast_path=fp1)
+    _bench_round(tmp_path / "BENCH_r02.json", p95_ms=10.0,
+                 tokens_per_sec=100.0, fast_path=fp2)
+    traj = _traj(tmp_path)
+    rows = {r["metric"]: r for r in traj["table"]}
+    assert rows["serve_fastpath_prefix_on_vs_off"]["regression"] is True
+    assert rows["serve_fastpath_prefix_on_vs_off"][
+        "lower_is_better"] is False
+    p99 = rows["serve_fastpath_inter_token_p99_ms_chunked"]
+    assert p99["lower_is_better"] is True
+    assert p99["regression"] is True  # 5ms -> 9ms: the stall came back
+    assert rows["serve_fastpath_cache_hit_rate"]["regression"] is False
 
 
 # ---------------------------------------------------------- cache shape ----
@@ -607,6 +746,35 @@ class TestServeTracing:
         for p, got in zip(prompts, outs):
             assert got == _oracle_greedy(params, p, 5), p
 
+    def test_fast_path_attribution_cached_vs_suffix_and_verify(
+            self, params, tracer):
+        """ISSUE 16: the attribution table splits prefill into the
+        cached-skip and the suffix actually computed, and tags verify
+        rounds with accepted-token counts — a warm full-hit request shows
+        cached time with ZERO suffix time."""
+        from tools.trace_report import render_serve_text, serve_attribution
+
+        eng = DecodeEngine(params, H, n_slots=2, max_len=MAXLEN,
+                           serve_dtype=None, prefix_cache=True,
+                           prefix_page_tokens=4, speculative=2)
+        prompt = _prompts(1, seed=18, lo=9, hi=10)[0]  # 2 pages = n-1
+        want = eng.generate(prompt, max_new_tokens=4)
+        assert eng.generate(prompt, max_new_tokens=4) == want
+        rows = sorted(serve_attribution(self._load(tracer)),
+                      key=lambda r: r["rid"])
+        assert len(rows) == 2
+        cold, warm = rows
+        assert cold["cached_tokens"] == 0
+        assert cold["prefill_suffix_ms"] > 0
+        assert warm["cached_tokens"] == 8
+        assert warm["prefill_cached_ms"] > 0
+        assert warm["prefill_suffix_ms"] == 0  # full hit: no prefill ran
+        for r in rows:
+            assert r["verify_steps"] > 0
+            assert 0 <= r["spec_accepted_tokens"] <= r["tokens"]
+        text = render_serve_text(rows)
+        assert "cached" in text and "acc" in text
+
     def test_zero_cost_unconfigured(self, params):
         """No tracer ⇒ no span objects anywhere on the request path."""
         from deeplearning4j_tpu.telemetry import trace as tr
@@ -739,6 +907,427 @@ def test_stats_and_retire_carry_weight_version(params, tmp_path):
                                        serve_dtype=None)
     assert eng.weight_version == "ckpt-step-7"
     assert eng.stats()["weight_version"] == "ckpt-step-7"
+
+
+# --------------------------------------- serving fast path (ISSUE 16) ----
+
+def _fresh_engine(params, reg=None, **kw):
+    from deeplearning4j_tpu.telemetry.registry import MetricsRegistry
+
+    reg = reg if reg is not None else MetricsRegistry()
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("max_len", MAXLEN)
+    kw.setdefault("serve_dtype", None)
+    return DecodeEngine(params, H, registry=reg, **kw), reg
+
+
+class TestSpeculative:
+    """Draft/verify speculative decoding, pinned token-identical to the
+    non-speculative recompute oracle — the whole point of the greedy
+    accept-longest-prefix rule is that speedup NEVER changes the stream."""
+
+    @pytest.mark.parametrize("k", [1, 2, 4])
+    def test_greedy_parity_across_k(self, params, k):
+        eng, reg = _fresh_engine(params, speculative=k)
+        for prompt in _prompts(3, seed=21):
+            got = eng.generate(prompt, max_new_tokens=6)
+            assert got == _oracle_greedy(params, prompt, 6), (k, prompt)
+        st = eng.stats()["speculative"]
+        assert st["k"] == k and st["verify_steps"] > 0
+        # the flagship ran ONE verify dispatch per round, k+1 draft steps
+        assert reg.counter("serve_spec_verify_steps_total").value == \
+            st["verify_steps"]
+        assert reg.counter("serve_spec_draft_steps_total").value == \
+            st["verify_steps"] * (k + 1)
+        # first-class accept metric: one observation per verify round
+        h = reg.histogram("serve_spec_accepted_per_verify")
+        assert h.count == st["verify_steps"]
+        assert h.sum == st["accepted_tokens"]
+        assert reg.histogram("serve_verify_step_ms").count == \
+            st["verify_steps"]
+
+    def test_all_accept_with_flagship_draft(self, params):
+        """draft == flagship (draft_layers=L): every proposal matches, so
+        every verify round emits k+1 tokens and accept_rate is exactly 1
+        — this pins the draft-cache frontier bookkeeping (a fully
+        accepted round must leave no K/V hole for the next round)."""
+        eng, _ = _fresh_engine(
+            params, speculative=SpeculativeConfig(k=2, draft_layers=L))
+        prompts = _prompts(3, seed=22)
+        reqs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        eng.run_until_idle()
+        for p, r in zip(prompts, reqs):
+            assert r.generated == _oracle_greedy(params, p, 6)
+        st = eng.stats()["speculative"]
+        assert st["accept_rate"] == 1.0
+        # every verify round emitted multiple tokens for one dispatch
+        assert st["accepted_tokens"] >= st["verify_steps"] * 2
+
+    def test_zero_accept_still_token_identical(self, params):
+        """A draft that ALWAYS proposes a token the flagship never emits
+        (decoder bias +1e9 on one vocab slot): every verify round
+        zero-accepts, emitting exactly the flagship's own greedy token —
+        the slow path of speculation is the baseline stream, not garbage."""
+        prompts = _prompts(3, seed=23)
+        oracles = [_oracle_greedy(params, p, 6) for p in prompts]
+        emitted = {t for o in oracles for t in o}
+        junk = next(t for t in range(V) if t not in emitted)
+        bias = np.zeros((V,), np.float32)
+        bias[junk] = 1e9
+        draft = {**params, "dec_b": params["dec_b"] + bias}
+        eng, reg = _fresh_engine(
+            params, speculative=SpeculativeConfig(k=2, draft_params=draft))
+        reqs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        eng.run_until_idle()
+        for o, r in zip(oracles, reqs):
+            assert r.generated == o
+        st = eng.stats()["speculative"]
+        assert st["verify_steps"] > 0 and st["accepted_tokens"] == 0
+        assert st["accept_rate"] == 0.0
+        assert reg.histogram("serve_spec_accepted_per_verify").sum == 0
+
+    def test_accept_longest_prefix_rule(self):
+        assert accept_longest_prefix([5, 7], [5, 7, 9]) == (2, [5, 7, 9])
+        assert accept_longest_prefix([5, 7], [5, 8, 9]) == (1, [5, 8])
+        assert accept_longest_prefix([5, 7], [6, 8, 9]) == (0, [6])
+        assert accept_longest_prefix([3], [3, 4]) == (1, [3, 4])
+        with pytest.raises(ValueError):
+            accept_longest_prefix([1, 2], [1, 2])  # needs k+1 verify toks
+
+    def test_resolve_speculative_seam(self, monkeypatch):
+        monkeypatch.delenv("DL4J_TPU_SERVE_SPEC", raising=False)
+        assert resolve_speculative() is None           # defaults OFF
+        assert resolve_speculative(False) is None
+        assert resolve_speculative(True) == SpeculativeConfig()
+        assert resolve_speculative(3).k == 3
+        cfg = SpeculativeConfig(k=4, draft_layers=2)
+        assert resolve_speculative(cfg) is cfg
+        with pytest.raises(TypeError):
+            resolve_speculative("yes")
+        monkeypatch.setenv("DL4J_TPU_SERVE_SPEC", "4:2")
+        env = resolve_speculative()
+        assert env.k == 4 and env.draft_layers == 2
+        monkeypatch.setenv("DL4J_TPU_SERVE_SPEC", "0")
+        assert resolve_speculative() is None
+        # explicit argument beats the env var
+        assert resolve_speculative(2).k == 2
+        monkeypatch.setenv("DL4J_TPU_SERVE_SPEC", "nope")
+        with pytest.raises(ValueError):
+            resolve_speculative()
+
+    def test_sampling_slots_ride_along_unbroken(self, params):
+        """temperature>0 slots batched next to greedy ones under
+        speculation: greedy parity holds and the sampled slot still gets
+        its full budget (it advances one token per verify round)."""
+        eng, _ = _fresh_engine(params, speculative=2)
+        pg, ps = _prompts(2, seed=24)
+        rg = eng.submit(pg, max_new_tokens=5, temperature=0.0)
+        rs = eng.submit(ps, max_new_tokens=5, temperature=1.0)
+        eng.run_until_idle()
+        assert rg.generated == _oracle_greedy(params, pg, 5)
+        assert len(rs.generated) == 5
+        assert all(0 <= t < V for t in rs.generated)
+
+    def test_near_max_len_falls_back_to_plain_decode(self, params):
+        """positions within k+1 of the cache edge would make the verify
+        write out of range (dynamic_update_slice CLAMPS — silent
+        corruption, not an error), so those ticks must take the plain
+        decode path; the request still retires at max_len with the exact
+        oracle stream."""
+        eng, _ = _fresh_engine(params, n_slots=1, max_len=16, speculative=4)
+        prompt = _prompts(1, seed=25, lo=10, hi=11)[0]  # len 10 of 16
+        req = eng.submit(prompt, max_new_tokens=50)
+        eng.run_until_idle()
+        assert req.finish_reason == "max_len"
+        want = _oracle_greedy(params, prompt, 16 - 10 + 1)
+        assert req.generated == want
+
+    def test_spec_steady_state_zero_retrace(self, params, retrace_budget):
+        """the 0-compile budget survives speculation: draft decode,
+        verify, and both prefill towers are pinned executables — a
+        varying accept count can never pay a retrace."""
+        eng, _ = _fresh_engine(params, speculative=2)
+        eng.generate([1] * 5, max_new_tokens=2)   # warm buckets 8
+        eng.generate([1] * 12, max_new_tokens=2)  # and 16
+        prompts = _prompts(4, seed=26)
+        with retrace_budget(0, label="speculative steady-state"):
+            reqs = [eng.submit(p, max_new_tokens=5) for p in prompts]
+            eng.run_until_idle()
+        for p, r in zip(prompts, reqs):
+            assert r.generated == _oracle_greedy(params, p, 5)
+
+
+class TestPrefixCache:
+    """Shared-prefix KV page reuse: cached pages seed the slot and only
+    the uncached suffix prefills — outputs pinned token-identical to the
+    cold engine across hit, miss, partial hit, and eviction/readmit."""
+
+    def test_full_hit_issues_zero_prefill_dispatches(self, params):
+        """THE acceptance pin: a fully cached prompt admits without ANY
+        prefill dispatch — the first token comes from the shared decode
+        step, and serve_prefill_dispatches_total stays flat."""
+        eng, reg = _fresh_engine(params, prefix_cache=True,
+                                 prefix_page_tokens=4)
+        prompt = _prompts(1, seed=31, lo=9, hi=10)[0]  # len 9: pages cover 8 = n-1
+        want = _oracle_greedy(params, prompt, 5)
+        assert eng.generate(prompt, max_new_tokens=5) == want
+        cold = reg.counter("serve_prefill_dispatches_total").value
+        assert cold >= 1
+        assert eng.generate(prompt, max_new_tokens=5) == want
+        assert reg.counter("serve_prefill_dispatches_total").value == cold
+        st = eng.stats()["prefix_cache"]
+        assert st["hits"] >= 1 and st["tokens_reused"] >= 8
+        assert reg.counter("serve_prefix_cache_hits_total").value >= 1
+        assert reg.gauge("serve_prefix_cache_hit_rate").value > 0
+
+    def test_partial_hit_prefills_only_suffix(self, params):
+        """Two prompts sharing a 8-token prefix: the second admission
+        reuses the shared pages and prefills just its own suffix (visible
+        as cached_tokens on the request and a shorter suffix span)."""
+        rng = np.random.RandomState(32)
+        shared = list(map(int, rng.randint(0, V, 8)))
+        a = shared + list(map(int, rng.randint(0, V, 5)))
+        b = shared + list(map(int, rng.randint(0, V, 7)))
+        eng, _ = _fresh_engine(params, prefix_cache=True,
+                               prefix_page_tokens=4)
+        assert eng.generate(a, max_new_tokens=4) == \
+            _oracle_greedy(params, a, 4)
+        req = eng.submit(b, max_new_tokens=4)
+        eng.run_until_idle()
+        assert req.generated == _oracle_greedy(params, b, 4)
+        assert req.cached_tokens == 8
+        assert req.prefill_cached_ms > 0 and req.prefill_suffix_ms > 0
+
+    def test_parity_under_eviction_pressure_and_readmit(self, params):
+        """capacity of 3 pages against 4-page prompts: every admission
+        evicts, and a prompt whose pages were evicted re-admits through
+        the cold path with identical output (evict → readmit parity)."""
+        from deeplearning4j_tpu.telemetry.registry import MetricsRegistry
+
+        reg = MetricsRegistry()
+        cache = PrefixPageCache(page_tokens=4, capacity_pages=3,
+                                registry=reg)
+        eng, _ = _fresh_engine(params, reg=reg, prefix_cache=cache)
+        prompts = _prompts(4, seed=33, lo=17, hi=20)
+        for _round in range(2):
+            for p in prompts:
+                assert eng.generate(p, max_new_tokens=4) == \
+                    _oracle_greedy(params, p, 4), p
+                cache.check_invariants()
+        st = cache.stats()
+        assert st["evictions"] > 0
+        assert st["pages"] <= 3
+        assert reg.counter("serve_prefix_cache_evictions_total").value == \
+            st["evictions"]
+
+    def test_lru_keeps_hot_chain_under_pressure(self, params):
+        """A hot prompt re-looked-up every round keeps its chain resident
+        while cold chains churn: its later admissions are full hits even
+        though the table is past capacity the whole time."""
+        cache = PrefixPageCache(page_tokens=4, capacity_pages=6)
+        eng, reg = _fresh_engine(params, prefix_cache=cache)
+        hot = _prompts(1, seed=34, lo=9, hi=10)[0]
+        cold = _prompts(3, seed=35, lo=9, hi=10)
+        want = _oracle_greedy(params, hot, 3)
+        assert eng.generate(hot, max_new_tokens=3) == want
+        for p in cold:
+            assert eng.generate(p, max_new_tokens=3) == \
+                _oracle_greedy(params, p, 3)
+            before = reg.counter("serve_prefill_dispatches_total").value
+            assert eng.generate(hot, max_new_tokens=3) == want
+            assert reg.counter(
+                "serve_prefill_dispatches_total").value == before
+        cache.check_invariants()
+
+    def test_divergent_prompts_copy_on_write(self, params):
+        """Prompts diverging INSIDE a page leave the shared parent chain
+        untouched and create sibling nodes — both replay token-identical
+        afterward (an insert can never corrupt a cached neighbor)."""
+        rng = np.random.RandomState(36)
+        shared = list(map(int, rng.randint(0, V, 4)))
+        a = shared + list(map(int, rng.randint(0, V, 6)))
+        b = shared + list(map(int, rng.randint(0, V, 6)))
+        assert a != b
+        cache = PrefixPageCache(page_tokens=4, capacity_pages=64)
+        eng, _ = _fresh_engine(params, prefix_cache=cache)
+        wa, wb = (_oracle_greedy(params, p, 4) for p in (a, b))
+        assert eng.generate(a, max_new_tokens=4) == wa
+        assert eng.generate(b, max_new_tokens=4) == wb
+        # replay both after the sibling insert: still exact
+        assert eng.generate(a, max_new_tokens=4) == wa
+        assert eng.generate(b, max_new_tokens=4) == wb
+        cache.check_invariants()
+        st = cache.stats()
+        assert st["pages"] >= 3  # shared root + two sibling chains
+
+    def test_refcounts_under_concurrent_submit_lockwatch(
+            self, params, lockwatch):
+        """N client threads hammer shared-prefix prompts through the
+        background scheduler with the lock-order watchdog armed: the page
+        table's refcount/parent invariants hold at every quiescent point
+        and no lock cycle forms between engine and cache locks."""
+        import threading
+
+        cache = PrefixPageCache(page_tokens=4, capacity_pages=8)
+        eng, _ = _fresh_engine(params, n_slots=3, prefix_cache=cache)
+        rng = np.random.RandomState(37)
+        shared = list(map(int, rng.randint(0, V, 8)))
+        eng.start()
+        errors = []
+
+        def client(i):
+            try:
+                rloc = np.random.RandomState(50 + i)
+                for _ in range(3):
+                    p = shared + list(map(int, rloc.randint(0, V, 5)))
+                    out = eng.generate(p, max_new_tokens=3, timeout=120.0)
+                    assert len(out) == 3
+            except BaseException as exc:  # noqa: BLE001 — surfaced below
+                errors.append(exc)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        try:
+            assert not errors, errors
+            assert not any(t.is_alive() for t in threads), "stress hung"
+            cache.check_invariants()
+            assert cache.stats()["hits"] > 0  # sharing really happened
+            # parity survives the churn
+            p = shared + [1, 2, 3]
+            assert eng.generate(p, max_new_tokens=3, timeout=120.0) == \
+                _oracle_greedy(params, p, 3)
+            cache.check_invariants()
+        finally:
+            eng.stop()
+        watch = lockwatch.summary()
+        assert watch["cycles"] == 0 and watch["watchdog_dumps"] == 0
+        assert watch["locks"].get("serve.prefix_cache",
+                                  {}).get("acquires", 0) > 0
+
+    def test_cache_unit_lookup_insert_evict(self):
+        """Table-level semantics without an engine: page-aligned prefix
+        match, page-granular insert, refcount-guarded LRU eviction."""
+        cache = PrefixPageCache(page_tokens=2, capacity_pages=3)
+        kv = np.arange(2 * 1 * 6 * 2, dtype=np.float32).reshape(2, 1, 6, 2)
+        assert cache.insert([1, 2, 3, 4, 5, 6], kv, kv) == 3
+        plen, ks, vs = cache.lookup([1, 2, 3, 4, 99, 98])
+        assert plen == 4 and len(ks) == 2
+        assert np.array_equal(np.asarray(ks[0]), kv[:, :, 0:2])
+        assert np.array_equal(np.asarray(ks[1]), kv[:, :, 2:4])
+        # interior nodes are eviction-immune while children live
+        cache.insert([9, 9], kv[:, :, :2], kv[:, :, :2])
+        st = cache.stats()
+        assert st["pages"] <= 3 and st["evictions"] >= 1
+        cache.check_invariants()
+        # the evicted leaf no longer matches; its parents still do
+        plen, _, _ = cache.lookup([1, 2, 3, 4, 5, 6])
+        assert plen in (2, 4)
+        with pytest.raises(ValueError):
+            PrefixPageCache(page_tokens=0)
+        with pytest.raises(ValueError):
+            PrefixPageCache(capacity_pages=0)
+
+
+class TestChunkedPrefill:
+    """Long prompts prefill in fixed-width chunks interleaved with decode
+    ticks — token-identical to unchunked, including at exact chunk
+    boundaries, with pinned chunk shapes for the 0-compile budget."""
+
+    @pytest.mark.parametrize("plen", [12, 13, 16, 5, 4])
+    def test_parity_at_chunk_boundaries(self, params, plen):
+        """prompt_len % chunk == 0 (12, 16, 4), != 0 (13), and shorter
+        than a chunk (the inline path) all match the oracle exactly."""
+        prompt = _prompts(1, seed=40 + plen, lo=plen, hi=plen + 1)[0]
+        assert len(prompt) == plen
+        eng, _ = _fresh_engine(params, prefill_chunk=4)
+        req = eng.submit(prompt, max_new_tokens=5)
+        eng.run_until_idle()
+        assert req.generated == _oracle_greedy(params, prompt, 5), plen
+        if plen > 4:
+            assert req.prefill_chunks >= 2
+
+    def test_decode_interleaves_with_chunked_prefill(self, params):
+        """A running stream keeps producing tokens WHILE a long prompt
+        chunk-prefills next to it (one chunk per scheduler iteration),
+        and both match their oracles — the head-of-line blocking the
+        chunking exists to kill is actually killed."""
+        eng, _ = _fresh_engine(params, prefill_chunk=4)
+        short = _prompts(1, seed=41)[0]
+        long_p = _prompts(1, seed=42, lo=20, hi=21)[0]
+        r_short = eng.submit(short, max_new_tokens=8)
+        eng.step()  # short admitted, first token out
+        tokens_before = len(r_short.generated)
+        r_long = eng.submit(long_p, max_new_tokens=4)
+        # drive while the long prompt is mid-chunking: the short stream
+        # must advance during at least one chunking iteration
+        advanced_mid_chunk = False
+        while not (r_short.done.is_set() and r_long.done.is_set()):
+            n0 = len(r_short.generated)
+            eng.step()
+            if eng.stats()["chunking_slots"] or r_long.slot in \
+                    eng._chunking:
+                advanced_mid_chunk |= len(r_short.generated) > n0
+        assert r_long.prefill_chunks >= 2
+        assert r_short.generated == _oracle_greedy(params, short, 8)
+        assert r_long.generated == _oracle_greedy(params, long_p, 4)
+        assert len(r_short.generated) > tokens_before
+
+    def test_chunk_plus_prefix_suffix_path(self, params):
+        """Chunked engine + prefix cache: the second admission seeds the
+        cached pages then chunk-prefills ONLY the suffix — fewer prefill
+        dispatches than the cold pass, same tokens."""
+        eng, reg = _fresh_engine(params, prefill_chunk=4,
+                                 prefix_cache=True, prefix_page_tokens=4)
+        rng = np.random.RandomState(43)
+        shared = list(map(int, rng.randint(0, V, 12)))
+        a = shared + list(map(int, rng.randint(0, V, 6)))
+        b = shared + list(map(int, rng.randint(0, V, 6)))
+        assert eng.generate(a, max_new_tokens=4) == \
+            _oracle_greedy(params, a, 4)
+        cold = reg.counter("serve_prefill_dispatches_total").value
+        req = eng.submit(b, max_new_tokens=4)
+        eng.run_until_idle()
+        warm = reg.counter("serve_prefill_dispatches_total").value - cold
+        assert req.generated == _oracle_greedy(params, b, 4)
+        assert req.cached_tokens == 12
+        assert warm < cold  # suffix-only prefill beat the cold pass
+
+    def test_chunked_steady_state_zero_retrace(self, params, retrace_budget):
+        """chunk shapes are pinned at width C: admitting long prompts of
+        DIFFERENT lengths retraces nothing once one chunked admission has
+        warmed the executable."""
+        eng, _ = _fresh_engine(params, prefill_chunk=4)
+        eng.generate([1] * 12, max_new_tokens=2)  # warm chunk W=4 + decode
+        prompts = _prompts(3, seed=44, lo=13, hi=24)
+        with retrace_budget(0, label="chunked-prefill steady-state"):
+            reqs = [eng.submit(p, max_new_tokens=3) for p in prompts]
+            eng.run_until_idle()
+        for p, r in zip(prompts, reqs):
+            assert r.generated == _oracle_greedy(params, p, 3)
+
+
+def test_all_fast_paths_composed_parity(params):
+    """prefix cache + chunked prefill + speculation in ONE engine: the
+    composed fast path is still pinned token-identical to the cold
+    baseline across a shared-prefix barrage."""
+    eng, reg = _fresh_engine(params, n_slots=3, prefix_cache=True,
+                             prefix_page_tokens=4, prefill_chunk=4,
+                             speculative=2)
+    rng = np.random.RandomState(45)
+    shared = list(map(int, rng.randint(0, V, 8)))
+    prompts = [shared + list(map(int, rng.randint(0, V, w)))
+               for w in (3, 5, 7, 3)]
+    reqs = [eng.submit(p, max_new_tokens=5) for p in prompts]
+    eng.run_until_idle()
+    for p, r in zip(prompts, reqs):
+        assert r.generated == _oracle_greedy(params, p, 5), p
+    st = eng.stats()
+    assert st["prefix_cache"]["hits"] > 0
+    assert st["speculative"]["verify_steps"] > 0
 
 
 def test_engine_metrics_record_flat_keys(params):

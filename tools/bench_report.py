@@ -41,6 +41,22 @@ comm/compute-overlap A/Bs, and a stage detail's top-level
 ``collective_wire_bytes`` contributes the LOWER-IS-BETTER
 ``<stage>_collective_wire_bytes`` row so a PR growing the compiled step's
 comm volume trips the regression gate both directions.
+
+ISSUE 16: (1) stage details carrying a ``fast_path`` block (the serving
+bench's prefix/speculative/chunked A/B twins) contribute
+``<stage>_fastpath_*`` rows — the on/off throughput ratios,
+cache_hit_rate and accepted_per_verify HIGHER-IS-BETTER, the inter-token
+p99s LOWER-IS-BETTER. (2) Bench-noise carry-over: rounds that ran the
+fixed ``ref_micro`` reference stage (a jitted loop that never changes,
+so its rate measures the machine, not the code) have every OTHER
+metric's latest-vs-previous delta normalized by the reference's drift
+factor ``f = ref_last/ref_prev`` when ``|f-1| <= 10%``; when the
+reference itself drifted MORE than 10% between the two rounds, deltas
+stay raw, regression-gating for that pair is SUPPRESSED (flag
+``REF-NOISE``), and the pair is listed under ``ref_flags`` — a broken
+reference must never silently normalize (or silently gate) anything.
+Rounds without the reference row (pre-ISSUE-16) behave exactly as
+before.
 """
 
 from __future__ import annotations
@@ -66,11 +82,23 @@ _METRIC_RE = re.compile(
     r"blocking_vs_background|overhead_pct|peak_bytes_ratio|"
     r"overlap_vs_strict|2d_vs_flat|prefetch_vs_rotate_after)$")
 # metrics where an INCREASE is the regression (ISSUE 9 footprint rows,
-# ISSUE 10 serving-latency rows, ISSUE 14 stage wire-byte rows)
+# ISSUE 10 serving-latency rows, ISSUE 14 stage wire-byte rows, ISSUE 16
+# inter-token-stream p99 rows)
 _LOWER_IS_BETTER_RE = re.compile(
     r"_profile_(?:peak_bytes|collective_bytes)$"
     r"|_latency_(?:p50|p95|p99|mean)_ms$"
-    r"|_collective_wire_bytes$")
+    r"|_collective_wire_bytes$"
+    r"|_inter_token_p99_ms(?:_chunked|_unchunked)?$")
+
+# ISSUE 16 bench-noise carry-over: the fixed reference micro-stage's row.
+# Its drift between two rounds is machine noise by construction (the
+# stage never changes), so it divides every other row's delta — unless it
+# drifted past REF_STABILITY_PCT, in which case the pair is flagged and
+# regression-gating suppressed instead of normalizing by a broken
+# reference. The ref row itself is tracked but never normalized and never
+# gates (a slower machine is not a code regression).
+REF_METRIC = "ref_micro_samples_per_sec"
+REF_STABILITY_PCT = 10.0
 # recovery regex for a truncated tail: top-level "key": number pairs
 _TAIL_PAIR_RE = re.compile(
     r'"([a-z0-9_]+)":\s*(-?[0-9]+(?:\.[0-9]+)?(?:[eE][-+]?[0-9]+)?)')
@@ -175,6 +203,32 @@ def _goodput_metrics(detail: Dict) -> Dict[str, float]:
     return out
 
 
+def _fastpath_metrics(detail: Dict) -> Dict[str, float]:
+    """Serve fast-path twin rows (ISSUE 16): a stage detail carrying a
+    ``fast_path`` block (the serving bench's prefix/spec/chunked A/Bs)
+    contributes ``<stage>_fastpath_*`` rows. The on/off ratios,
+    cache_hit_rate and accepted_per_verify are HIGHER-IS-BETTER (the
+    default direction); the inter-token p99s match the LOWER-IS-BETTER
+    regex, so a chunk-scheduling change that re-introduces the long-
+    prompt stream stall trips ``--fail-on-regression``."""
+    tracked = ("prefix_on_vs_off", "spec_on_vs_off", "chunk_vs_unchunked",
+               "cache_hit_rate", "accepted_per_verify",
+               "inter_token_p99_ms_chunked", "inter_token_p99_ms_unchunked")
+    out: Dict[str, float] = {}
+    for key, val in detail.items():
+        if not key.endswith("_detail") or not isinstance(val, dict):
+            continue
+        fp = val.get("fast_path")
+        if not isinstance(fp, dict):
+            continue
+        stage = key[: -len("_detail")]
+        for src in tracked:
+            v = fp.get(src)
+            if isinstance(v, (int, float)):
+                out[f"{stage}_fastpath_{src}"] = float(v)
+    return out
+
+
 def load_rounds(bench_dir: str) -> List[Dict]:
     """One record per BENCH_r*.json: {round, source, metrics, headline}."""
     rounds = []
@@ -199,6 +253,7 @@ def load_rounds(bench_dir: str) -> List[Dict]:
             metrics.update(_latency_metrics(detail))
             metrics.update(_wire_metrics(detail))
             metrics.update(_goodput_metrics(detail))
+            metrics.update(_fastpath_metrics(detail))
             rounds.append({"round": int(m.group(1)), "source": "parsed",
                            "metrics": metrics,
                            "headline": parsed.get("value")})
@@ -213,24 +268,50 @@ def load_rounds(bench_dir: str) -> List[Dict]:
 
 def build_trajectory(rounds: List[Dict], threshold_pct: float = 10.0
                      ) -> Dict:
-    """Per-metric series across rounds + latest-vs-previous deltas."""
+    """Per-metric series across rounds + latest-vs-previous deltas.
+
+    ISSUE 16 noise carry-over: when BOTH rounds of a metric's delta pair
+    ran the fixed reference stage (:data:`REF_METRIC`), the delta is
+    computed on ``last / f`` where ``f = ref_last / ref_prev`` — machine
+    drift divides out. A reference drift past
+    :data:`REF_STABILITY_PCT` instead flags the pair (``ref_flags``)
+    and suppresses regression-gating for it: deltas stay raw and rows
+    that would have gated carry ``suppressed_by_ref``. Pairs where
+    either round lacks the reference row behave exactly as before."""
     keys = sorted({k for r in rounds for k in r["metrics"]})
+    ref_series = {r["round"]: r["metrics"].get(REF_METRIC) for r in rounds}
     table = []
     regressions = []
+    ref_flag_pairs: Dict[tuple, float] = {}
     for key in keys:
         series = [(r["round"], r["metrics"].get(key)) for r in rounds]
         present = [(n, v) for n, v in series if v is not None]
         delta_pct: Optional[float] = None
+        ref_factor: Optional[float] = None
+        ref_unstable = False
         if len(present) >= 2:
             (prev_n, prev), (last_n, last) = present[-2], present[-1]
             if prev:
+                ref_prev = ref_series.get(prev_n)
+                ref_last = ref_series.get(last_n)
+                if key != REF_METRIC and ref_prev and ref_last:
+                    f = ref_last / ref_prev
+                    if abs(f - 1.0) <= REF_STABILITY_PCT / 100.0:
+                        ref_factor = round(f, 4)
+                        last = last / f  # divide the machine drift out
+                    else:
+                        ref_unstable = True
+                        ref_flag_pairs[(prev_n, last_n)] = round(f, 4)
                 delta_pct = round((last - prev) / abs(prev) * 100.0, 2)
         lower_better = bool(_LOWER_IS_BETTER_RE.search(key))
-        regressed = (delta_pct is not None
-                     and (delta_pct > threshold_pct if lower_better
-                          else delta_pct < -threshold_pct))
+        would_regress = (delta_pct is not None and key != REF_METRIC
+                         and (delta_pct > threshold_pct if lower_better
+                              else delta_pct < -threshold_pct))
+        regressed = would_regress and not ref_unstable
         row = {"metric": key, "series": series, "delta_pct": delta_pct,
-               "lower_is_better": lower_better, "regression": regressed}
+               "lower_is_better": lower_better, "regression": regressed,
+               "ref_factor": ref_factor,
+               "suppressed_by_ref": would_regress and ref_unstable}
         if row["regression"]:
             regressions.append({"metric": key, "delta_pct": delta_pct,
                                 "lower_is_better": lower_better,
@@ -245,6 +326,9 @@ def build_trajectory(rounds: List[Dict], threshold_pct: float = 10.0
         "threshold_pct": threshold_pct,
         "table": table,
         "regressions": regressions,
+        "ref_metric": REF_METRIC,
+        "ref_flags": [{"from_round": a, "to_round": b, "ref_factor": f}
+                      for (a, b), f in sorted(ref_flag_pairs.items())],
     }
 
 
@@ -268,8 +352,21 @@ def render_text(traj: Dict) -> str:
             for n in round_ids)
         delta = (f"{row['delta_pct']:>+9.1f}"
                  if row["delta_pct"] is not None else f"{'-':>9}")
-        flag = "REGRESSION" if row["regression"] else ""
+        if row["regression"]:
+            flag = "REGRESSION"
+        elif row.get("suppressed_by_ref"):
+            flag = "REF-NOISE"
+        elif row.get("ref_factor") is not None:
+            flag = f"ref f={row['ref_factor']:.3f}"
+        else:
+            flag = ""
         lines.append(f"{row['metric']:<{width}}  {cells}  {delta}  {flag}")
+    if traj.get("ref_flags"):
+        lines += ["", "reference stage drifted past the stability window "
+                  "— deltas raw, gating suppressed for:"]
+        lines += [f"  r{f['from_round']} -> r{f['to_round']}: "
+                  f"{traj['ref_metric']} moved x{f['ref_factor']}"
+                  for f in traj["ref_flags"]]
     if traj["regressions"]:
         lines += ["", f"{len(traj['regressions'])} regression(s) past "
                   f"±{traj['threshold_pct']}% vs previous round:"]

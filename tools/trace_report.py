@@ -273,15 +273,35 @@ def serve_attribution(spans: Dict[str, Dict]) -> List[Dict]:
             queue_ms = child_dur(sp, "serve.queue_wait")[0]
         if prefill_ms is None:
             prefill_ms = child_dur(sp, "serve.prefill")[0]
+        dspan = None
         if decode_ms is None:
             dms, dspan = child_dur(sp, "serve.decode")
             decode_ms = (dspan.get("attrs", {}).get("decode_ms")
                          if dspan is not None else None) or dms
+        else:
+            dspan = child_dur(sp, "serve.decode")[1]
         total_ms = attrs.get("latency_ms", sp.get("dur_ms"))
         gap_ms = attrs.get("gap_ms")
         if gap_ms is None and None not in (total_ms, queue_ms, prefill_ms,
                                            decode_ms):
             gap_ms = round(total_ms - queue_ms - prefill_ms - decode_ms, 3)
+        # ISSUE 16: prefill splits into the cached-skip (prefix pages
+        # seeded from the page table) and the suffix actually computed —
+        # preferring the retire attrs, falling back to the prefill child
+        # span's own attribution for open/killed requests
+        pspan = child_dur(sp, "serve.prefill")[1]
+        pattrs = pspan.get("attrs", {}) if pspan is not None else {}
+        cached_ms = attrs.get("prefill_cached_ms",
+                              pattrs.get("cached_ms"))
+        suffix_ms = attrs.get("prefill_suffix_ms",
+                              pattrs.get("suffix_ms"))
+        cached_tokens = attrs.get("cached_tokens",
+                                  pattrs.get("cached_tokens"))
+        # speculative verify rounds ride the decode span as "verify"
+        # events tagged with their accepted-token counts
+        verifies = [ev for ev in (dspan.get("events", [])
+                                  if dspan is not None else [])
+                    if ev.get("name") == "verify"]
         rows.append({
             "rid": attrs.get("rid"),
             "trace_id": sp.get("trace_id"),
@@ -290,10 +310,16 @@ def serve_attribution(spans: Dict[str, Dict]) -> List[Dict]:
             "start": sp.get("start"),
             "queue_wait_ms": queue_ms,
             "prefill_ms": prefill_ms,
+            "prefill_cached_ms": cached_ms,
+            "prefill_suffix_ms": suffix_ms,
+            "cached_tokens": cached_tokens,
             "decode_ms": decode_ms,
             "gap_ms": gap_ms,
             "total_ms": total_ms,
             "tokens": attrs.get("tokens"),
+            "verify_steps": len(verifies),
+            "spec_accepted_tokens": sum(
+                int(ev.get("accepted", 0)) for ev in verifies),
             "finish_reason": attrs.get("finish_reason"),
             "weight_version": attrs.get("weight_version"),
         })
@@ -310,19 +336,26 @@ def render_serve_text(rows: List[Dict]) -> str:
         return f"{v:>{w}.2f}" if isinstance(v, (int, float)) else f"{'-':>{w}}"
 
     hdr = (f"{'rid':>5}  {'status':<7}  {'queue':>8}  {'prefill':>8}  "
-           f"{'decode':>8}  {'gap':>8}  {'total':>8}  {'tok':>4}  "
-           f"{'reason':<14}  weights")
+           f"{'cached':>8}  {'decode':>8}  {'gap':>8}  {'total':>8}  "
+           f"{'tok':>4}  {'acc':>4}  {'reason':<14}  weights")
     lines = ["", f"serve requests — latency attribution (ms), "
              f"{len(rows)} request(s), "
-             f"{sum(1 for r in rows if r['status'] == 'open')} open",
+             f"{sum(1 for r in rows if r['status'] == 'open')} open "
+             f"(cached = prefix-cache skip inside prefill; acc = "
+             f"speculative tokens accepted)",
              hdr, "-" * len(hdr)]
     for r in rows:
         rid = r["rid"] if r["rid"] is not None else "?"
         tok = r["tokens"] if r["tokens"] is not None else "-"
+        acc = (r["spec_accepted_tokens"] if r.get("verify_steps")
+               else "-")
         lines.append(
             f"{rid:>5}  {r['status']:<7}  {fmt(r['queue_wait_ms'], 8)}  "
-            f"{fmt(r['prefill_ms'], 8)}  {fmt(r['decode_ms'], 8)}  "
+            f"{fmt(r['prefill_ms'], 8)}  "
+            f"{fmt(r.get('prefill_cached_ms'), 8)}  "
+            f"{fmt(r['decode_ms'], 8)}  "
             f"{fmt(r['gap_ms'], 8)}  {fmt(r['total_ms'], 8)}  {tok:>4}  "
+            f"{acc:>4}  "
             f"{str(r['finish_reason'] or '-'):<14}  "
             f"{r['weight_version'] or '-'}")
     return "\n".join(lines)
