@@ -425,7 +425,7 @@ def init_lm_opt_state(optimizer, params, mesh: Optional[Mesh] = None):
 
 def _make_opt_step(loss_fn, lr: float, with_metrics: bool, optimizer,
                    zero, donate: bool = False, guard=None, profile=None,
-                   profile_label: str = "lm_step"):
+                   profile_label: str = "lm_step", runprof=None):
     """The optimizer-threaded twin of ``_make_sgd_step``:
     ``step(params, opt_state, tokens, targets) -> (new_params,
     new_opt_state, loss[, metrics/guard block])``. The loss+grad graph is
@@ -441,9 +441,11 @@ def _make_opt_step(loss_fn, lr: float, with_metrics: bool, optimizer,
     donate_argnums = (0, 1) if donate else ()
 
     def _seam(step):
+        from deeplearning4j_tpu.telemetry.runprof import maybe_runprof
         from deeplearning4j_tpu.telemetry.xprofile import maybe_profiled
 
-        return maybe_profiled(step, profile, profile_label)
+        return maybe_runprof(maybe_profiled(step, profile, profile_label),
+                             runprof, profile_label)
 
     if not with_metrics:
         @partial(jax.jit, donate_argnums=donate_argnums)
@@ -487,7 +489,7 @@ def _make_opt_step(loss_fn, lr: float, with_metrics: bool, optimizer,
 
 def _make_sgd_step(loss_fn, lr: float, with_metrics: bool,
                    donate: bool = False, guard=None, profile=None,
-                   profile_label: str = "lm_step"):
+                   profile_label: str = "lm_step", runprof=None):
     """jitted SGD step; with metrics the loss fn returns (loss, aux) and the
     step appends the grad/param-norm block — the loss+grad graph itself is
     the SAME ops either way (bit-parity pinned in tests/test_telemetry.py).
@@ -516,9 +518,11 @@ def _make_sgd_step(loss_fn, lr: float, with_metrics: bool,
     donate_argnums = (0,) if donate else ()
 
     def _seam(step):
+        from deeplearning4j_tpu.telemetry.runprof import maybe_runprof
         from deeplearning4j_tpu.telemetry.xprofile import maybe_profiled
 
-        return maybe_profiled(step, profile, profile_label)
+        return maybe_runprof(maybe_profiled(step, profile, profile_label),
+                             runprof, profile_label)
 
     if guard is not None:
         from deeplearning4j_tpu.optimize.guardrails import guarded_sgd_update
@@ -572,7 +576,7 @@ def make_composed_train_step(mesh: Mesh, n_heads: int, capacity: int,
                              with_metrics: bool = False,
                              donate: bool = False, guard=None,
                              profile=None, optimizer=None,
-                             ring_prefetch: bool = True):
+                             ring_prefetch: bool = True, runprof=None):
     """SGD step over the composed mesh: step(params, tokens, targets) ->
     (new_params, loss). Shard inputs with shard_lm_params/shard_lm_batch
     first; GSPMD + the shard_map transposes insert every collective
@@ -601,6 +605,17 @@ def make_composed_train_step(mesh: Mesh, n_heads: int, capacity: int,
     the MoE all_to_all exchange (when the alltoall dispatch resolves);
     see telemetry/xprofile.py.
 
+    ``runprof=`` (ISSUE 17; ``True``, a label string, or a
+    ``telemetry.runprof.RunProfiler``) arms the continuous runtime
+    profiler: every call is phase-timed (host gap / dispatch / fenced
+    device wall) into ring-buffered ``StepTiming`` records and the
+    streaming ``runprof_*`` gauges; composes over ``profile=`` (the
+    xprofile FLOPs feed ``runprof_measured_mfu``). The default
+    (``None``) stays unwrapped unless ``DL4J_TPU_RUNPROF`` is set;
+    ``False`` opts out regardless. NOTE an armed step fences every call
+    (that is the measurement), so arm it for measurement, not peak
+    throughput.
+
     ``optimizer=`` (ISSUE 13; a name string — "adam" | "lamb" | "adagrad"
     | "momentum" — or an ``optimize.updaters.OptimizerConfig``) swaps the
     SGD update for the in-graph stateful updater: the step becomes
@@ -628,10 +643,11 @@ def make_composed_train_step(mesh: Mesh, n_heads: int, capacity: int,
         return _make_opt_step(loss_fn, lr, with_metrics,
                               opt_cfg.resolved(), zero, donate=donate,
                               guard=GuardConfig.coerce(guard),
-                              profile=profile, profile_label=label)
+                              profile=profile, profile_label=label,
+                              runprof=runprof)
     return _make_sgd_step(loss_fn, lr, with_metrics, donate=donate,
                           guard=GuardConfig.coerce(guard), profile=profile,
-                          profile_label=label)
+                          profile_label=label, runprof=runprof)
 
 
 def make_single_device_train_step(n_heads: int, lr: float = 0.1,
@@ -639,11 +655,13 @@ def make_single_device_train_step(n_heads: int, lr: float = 0.1,
                                   attn_impl: Optional[str] = None,
                                   with_metrics: bool = False,
                                   donate: bool = False, guard=None,
-                                  profile=None, optimizer=None):
+                                  profile=None, optimizer=None,
+                                  runprof=None):
     """The dense twin of make_composed_train_step (parity oracle when
     called with ``attn_impl="dense"``; the flagship single-chip bench path
     with the default auto core). ``with_metrics``/``donate``/``guard``/
-    ``profile``/``optimizer`` as on the composed builder (bench hot loops
+    ``profile``/``optimizer``/``runprof`` as on the composed builder
+    (bench hot loops
     pass donate=True; the guardrails bench stage passes guard=True on
     top; the profile stage passes profile=True). With ``optimizer=`` the
     step carries the opt state (``init_lm_opt_state(optimizer, params)``)
@@ -666,10 +684,12 @@ def make_single_device_train_step(n_heads: int, lr: float = 0.1,
                               opt_cfg.resolved(), None, donate=donate,
                               guard=GuardConfig.coerce(guard),
                               profile=profile,
-                              profile_label="lm_single_device")
+                              profile_label="lm_single_device",
+                              runprof=runprof)
     return _make_sgd_step(loss_fn, lr, with_metrics, donate=donate,
                           guard=GuardConfig.coerce(guard), profile=profile,
-                          profile_label="lm_single_device")
+                          profile_label="lm_single_device",
+                          runprof=runprof)
 
 
 # ----------------------------------------------------------------- dp×pp ----

@@ -358,7 +358,7 @@ def make_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
                              batch_axis: "str | None" = None,
                              with_metrics: bool = False, guard=None,
                              profile=None, optimizer=None,
-                             overlap: bool = False):
+                             overlap: bool = False, runprof=None):
     """SGD train step over the pipelined stack.
 
     loss = mean over microbatches of ``loss_fn(y, labels_mb)`` on the
@@ -405,11 +405,18 @@ def make_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
         guarded_sgd_update,
     )
     from deeplearning4j_tpu.optimize.updaters import OptimizerConfig
+    from deeplearning4j_tpu.telemetry.runprof import maybe_runprof
     from deeplearning4j_tpu.telemetry.xprofile import maybe_profiled
 
     guard = GuardConfig.coerce(guard)
     label = (f"pipeline[{axis}" + (f"x{batch_axis}]" if batch_axis else "]")
              + ("+overlap" if overlap else ""))
+
+    def _seam(step):
+        # profile= then runprof= (ISSUE 17): the runprof wrapper reuses
+        # the ProfiledStep's FLOPs/collectives for MFU and comm-wait
+        return maybe_runprof(maybe_profiled(step, profile, label),
+                             runprof, label)
 
     def loss_of(params, x_mbs, y_mbs):
         outs = pipeline_apply(params, x_mbs, stage_fn, mesh, axis,
@@ -456,7 +463,7 @@ def make_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
                 })
             return new_params, new_state, loss, metrics
 
-        return maybe_profiled(opt_step, profile, label)
+        return _seam(opt_step)
 
     if not with_metrics and guard is None:
         @partial(jax.jit, donate_argnums=(0,))
@@ -467,7 +474,7 @@ def make_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
                 lambda p, g: p - lr * g, params, grads)
             return new_params, loss
 
-        return maybe_profiled(step, profile, label)
+        return _seam(step)
 
     from deeplearning4j_tpu.telemetry.metrics import train_step_metrics
 
@@ -490,4 +497,4 @@ def make_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
             })
         return new_params, loss, metrics
 
-    return maybe_profiled(step, profile, label)
+    return _seam(step)

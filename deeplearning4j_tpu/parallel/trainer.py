@@ -225,7 +225,7 @@ def init_sync_opt_state(optimizer, params, mesh: Mesh):
 def make_sync_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
                          ablate_collectives: bool = False,
                          with_metrics: bool = False, guard=None,
-                         profile=None, optimizer=None):
+                         profile=None, optimizer=None, runprof=None):
     """Per-step averaging: grads AllReduced every iteration.
 
     step(params, states, iteration, x, y, w, key) — ``w`` is the per-row
@@ -268,6 +268,7 @@ def make_sync_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
     """
     from deeplearning4j_tpu.optimize.guardrails import GuardConfig
     from deeplearning4j_tpu.optimize.updaters import OptimizerConfig
+    from deeplearning4j_tpu.telemetry.runprof import maybe_runprof
     from deeplearning4j_tpu.telemetry.xprofile import maybe_profiled
 
     guard = GuardConfig.coerce(guard)
@@ -299,8 +300,10 @@ def make_sync_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
         out_specs=out_specs,
         check_vma=False,
     )
-    return maybe_profiled(jax.jit(sharded, donate_argnums=(0, 1)), profile,
-                          f"dp_sync[{mesh.shape[DATA_AXIS]}]")
+    label = f"dp_sync[{mesh.shape[DATA_AXIS]}]"
+    return maybe_runprof(
+        maybe_profiled(jax.jit(sharded, donate_argnums=(0, 1)), profile,
+                       label), runprof, label)
 
 
 def make_local_fit_step(conf: MultiLayerConfiguration, mesh: Mesh,

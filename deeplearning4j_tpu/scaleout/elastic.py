@@ -210,7 +210,7 @@ class SyntheticRegressionModel(ElasticModel):
                  guard: bool = False, clip_norm: Optional[float] = None,
                  nan_at_step: Optional[int] = None,
                  nan_worker_seed: Optional[int] = None,
-                 profile: bool = False, optimizer=None):
+                 profile: bool = False, optimizer=None, runprof=None):
         self.d_in, self.d_hidden = int(d_in), int(d_hidden)
         self.batch, self.lr, self.seed = int(batch), float(lr), int(seed)
         self.mesh_devices = int(mesh_devices)
@@ -227,6 +227,9 @@ class SyntheticRegressionModel(ElasticModel):
         # simulate_elastic stays an exact oracle when every worker uses
         # the same knobs.
         self.optimizer = optimizer
+        # ISSUE 17: the runprof= seam — phase-timed worker steps feeding
+        # the runprof_* gauges (None = env-knob default; False = off)
+        self.runprof = runprof
         self.skipped_steps = 0
         self._step = None
         self._mesh = None
@@ -314,11 +317,12 @@ class SyntheticRegressionModel(ElasticModel):
                         guard_cfg, zero=zero)
                     return new, state, loss, gm["nonfinite"]
 
+            from deeplearning4j_tpu.telemetry.runprof import maybe_runprof
             from deeplearning4j_tpu.telemetry.xprofile import maybe_profiled
 
-            self._step = maybe_profiled(
+            self._step = maybe_runprof(maybe_profiled(
                 jax.jit(step, donate_argnums=(0, 1)), self.profile,
-                "elastic_worker")
+                "elastic_worker"), self.runprof, "elastic_worker")
             return
 
         if guard_cfg is None:
@@ -338,10 +342,13 @@ class SyntheticRegressionModel(ElasticModel):
                                              guard_cfg)
                 return new, loss, gm["nonfinite"]
 
+        from deeplearning4j_tpu.telemetry.runprof import maybe_runprof
         from deeplearning4j_tpu.telemetry.xprofile import maybe_profiled
 
-        self._step = maybe_profiled(jax.jit(step, donate_argnums=(0,)),
-                                    self.profile, "elastic_worker")
+        self._step = maybe_runprof(
+            maybe_profiled(jax.jit(step, donate_argnums=(0,)),
+                           self.profile, "elastic_worker"),
+            self.runprof, "elastic_worker")
 
     @property
     def step_profile(self):

@@ -198,8 +198,9 @@ class DecodeEngine:
                  prefix_cache=False, prefix_page_tokens: int = 16,
                  prefix_cache_pages: int = 256,
                  prefill_chunk: Optional[int] = None,
-                 speculative=None):
+                 speculative=None, runprof=None):
         from deeplearning4j_tpu.telemetry.registry import default_registry
+        from deeplearning4j_tpu.telemetry.runprof import resolve_runprof
 
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
@@ -286,6 +287,18 @@ class DecodeEngine:
         # the counter the full-prefix-hit pin asserts against exists (at
         # 0) from construction; spec instruments likewise when armed
         self.registry.counter("serve_prefill_dispatches_total")
+        # runtime profiler (ISSUE 17): the scheduler loop phase-times
+        # each decode tick into the runprof rings/gauges when armed —
+        # instruments pre-created HERE so the first flush's increment
+        # is visible to rate windows (the PR 15 discipline; the
+        # decode tick carries no xprofile FLOPs, so the "<"-trapped
+        # runprof_measured_mfu gauge stays unborn)
+        self._runprof = resolve_runprof(runprof)
+        if self._runprof is not None and self._runprof._registry is None:
+            # an engine on a private registry keeps its profiler there too
+            self._runprof._registry = self.registry
+        if self._runprof is not None:
+            self._runprof.arm("serve_decode")
         if self.spec is not None:
             for name in ("serve_spec_verify_steps_total",
                          "serve_spec_accepted_tokens_total",
@@ -739,6 +752,7 @@ class DecodeEngine:
         tracer = _trace.get_tracer()
         step_span = (tracer.start_span("engine.step", parent=False)
                      if tracer is not None else None)
+        t_sched0 = time.perf_counter()  # runprof phase clock (ISSUE 17)
         with self._lock:
             tokens_before = self.tokens_total
             free = self._free_slots()
@@ -779,6 +793,10 @@ class DecodeEngine:
                         <= self.max_len for r in active))
             if spec_tick:
                 decode_ms = self._spec_step(active, step_span)
+                # spec ticks interleave k+1 draft dispatches with their
+                # fences; no clean dispatch/device split — attribute the
+                # whole measured wall to the device phase
+                rp_dispatch_ms, rp_device_ms = 0.0, decode_ms
             else:
                 t0 = time.perf_counter()
                 self._cache, toks = self._decode(
@@ -786,9 +804,12 @@ class DecodeEngine:
                     self._positions, self._temps, self._key,
                     self._step_idx)
                 self._step_idx += 1
+                t_disp = time.perf_counter()  # enqueue back; device runs
                 toks = np.asarray(toks)  # graftlint: allow[blocking-under-lock] deliberate: retirement must see the fenced decode tokens; submit() blocks here only between decode steps
                 now = time.perf_counter()
                 decode_ms = (now - t0) * 1000.0
+                rp_dispatch_ms = (t_disp - t0) * 1000.0
+                rp_device_ms = (now - t_disp) * 1000.0
                 self.registry.histogram("serve_decode_step_ms").observe(
                     decode_ms)
                 self.decode_steps += 1
@@ -810,6 +831,20 @@ class DecodeEngine:
                 step_span.set_attr("queue_depth", len(self._queue))
                 step_span.set_attr("decode_ms", round(decode_ms, 3))
                 step_span.end()
+            if self._runprof is not None:
+                from deeplearning4j_tpu.telemetry.runprof import StepTiming
+                t_rp_end = time.perf_counter()
+                # host phase = this tick's scheduler work (admission,
+                # chunked prefill, retirement) — everything outside the
+                # decode dispatch+fence
+                sched_ms = max(
+                    0.0, (t_rp_end - t_sched0) * 1000.0 - decode_ms)
+                self._runprof.record(StepTiming(
+                    label="serve_decode", t_unix=time.time(),
+                    wall_ms=decode_ms, host_ms=sched_ms,
+                    dispatch_ms=rp_dispatch_ms, device_ms=rp_device_ms,
+                    trace_id=(step_span.trace_id
+                              if step_span is not None else None)))
             return self.tokens_total - tokens_before
 
     def _spec_step(self, active: List[ServeRequest], step_span) -> float:

@@ -34,7 +34,15 @@ Three layers, one subsystem:
   introspection of every jitted step behind the ``profile=`` seam —
   XLA cost/memory analysis, HLO collective inventory, measured-MFU /
   roofline attribution, live memory watermarks, served at
-  ``/api/profile`` and reported by tools/profile_report.py.
+  ``/api/profile`` and reported by tools/profile_report.py;
+- **runtime profiling** (runprof.py, ISSUE 17): measured step-phase
+  timelines behind the ``runprof=`` seam — ring-buffered host/dispatch/
+  device/comm-wait breakdowns, streaming ``runprof_*`` gauges (steps/s,
+  measured MFU, host + input-wait fractions), and on-demand N-step
+  capture sessions (write-ahead JSONL + atomic JSON + Chrome trace
+  events on the span-tree trace ids) controlled at ``/api/profiling``
+  or ``DL4J_TPU_RUNPROF``, rendered by
+  ``tools/profile_report.py --runtime``.
 
 The listener chain bridges in via optimize/listeners.MetricsIterationListener
 and the scaleout counters via the statetracker registry mirror.
@@ -100,6 +108,20 @@ from deeplearning4j_tpu.telemetry.step_log import (
     read_step_log,
     summarize_step_log,
 )
+from deeplearning4j_tpu.telemetry.runprof import (
+    RunProfiledStep,
+    RunProfiler,
+    StepTiming,
+    chrome_trace_events,
+    default_runprof,
+    find_sessions,
+    get_runprof,
+    load_session,
+    maybe_runprof,
+    resolve_runprof,
+    set_runprof,
+    summarize_session,
+)
 from deeplearning4j_tpu.telemetry.xprofile import (
     MemoryWatermarkSampler,
     ProfiledStep,
@@ -127,15 +149,23 @@ __all__ = [
     "PROMETHEUS_CONTENT_TYPE",
     "ProfileStore",
     "ProfiledStep",
+    "RunProfiledStep",
+    "RunProfiler",
     "Span",
     "StepLogWriter",
     "StepProfile",
+    "StepTiming",
     "Tracer",
     "TrainTelemetry",
     "Watchtower",
     "arm_watchtower",
     "attribute",
+    "chrome_trace_events",
     "default_profile_store",
+    "default_runprof",
+    "find_sessions",
+    "load_session",
+    "maybe_runprof",
     "profile_compiled",
     "profile_lowered",
     "current_trace_context",
@@ -145,6 +175,7 @@ __all__ = [
     "format_traceparent",
     "get_engine",
     "get_history",
+    "get_runprof",
     "get_tracer",
     "maybe_span",
     "merge_snapshots",
@@ -152,9 +183,12 @@ __all__ = [
     "read_spill",
     "render_snapshot",
     "replay_spill",
+    "resolve_runprof",
     "set_engine",
     "set_history",
+    "set_runprof",
     "set_tracer",
+    "summarize_session",
     "global_norm",
     "read_step_log",
     "render_prometheus",

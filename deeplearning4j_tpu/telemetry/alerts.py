@@ -527,6 +527,22 @@ def default_rules() -> List[AlertRule]:
       (stale draft after a weight swap, or a draft too weak for the
       traffic). The gauge is born after the engine's warmup floor of
       verify steps, so startup noise can't page.
+    - ``step_time_regression`` — runtime profiler (ISSUE 17):
+      rate-of-change on the measured step-time gauge
+      (``runprof_step_ms``, signed delta/s) — sustained growth means
+      creeping retraces, a straggler, or thermal throttling. Sized so
+      a gauge BIRTH (0 → one real step time) cannot fire: the birth
+      jump leaves the delta window after ``window_s`` seconds, and
+      ``for_s > window_s`` means only growth that OUTLASTS the window
+      — i.e. step time still climbing — pages.
+    - ``mfu_collapse`` — runtime profiler (ISSUE 17): measured MFU
+      (xprofile FLOPs / fenced device seconds) collapsed below 1%
+      sustained. Op ``<``, so the gauge stays UNBORN until a profiled
+      runprof step emits a real value (the pre-arm trap below) — a
+      process that never measures MFU stays inactive.
+    - ``input_wait_high`` — runtime profiler (ISSUE 17): the
+      input-wait hook reports the step spending >30% of its cycle
+      starved for host data — the ROADMAP 5 starvation signal.
     """
     return [
         AlertRule(
@@ -595,6 +611,28 @@ def default_rules() -> List[AlertRule]:
                         "collapsed below 10% — draft proposals no "
                         "longer track the flagship, verify dispatches "
                         "are wasted"),
+        AlertRule(
+            name="step_time_regression", kind="rate", use_delta=True,
+            metric="runprof_step_ms", threshold=5.0, op=">",
+            window_s=30.0, for_s=45.0, severity="warning",
+            description="measured step time growing >5 ms/s sustained "
+                        "past the delta window — creeping retraces, a "
+                        "straggler, or throttling (a one-off jump "
+                        "resolves when it leaves the window)"),
+        AlertRule(
+            name="mfu_collapse", kind="threshold",
+            metric="runprof_measured_mfu", threshold=0.01,
+            op="<", for_s=120.0, severity="warning",
+            description="measured MFU (xprofile FLOPs / fenced device "
+                        "seconds) below 1% sustained — the step is "
+                        "running but the accelerator is idle"),
+        AlertRule(
+            name="input_wait_high", kind="threshold",
+            metric="runprof_input_wait_fraction", threshold=0.3,
+            op=">", for_s=60.0, severity="warning",
+            description="steps spend >30% of their cycle waiting on "
+                        "host input — the data pipeline is starving "
+                        "the device"),
     ]
 
 

@@ -198,10 +198,12 @@ def summarize_step_log(records: List[Dict]) -> Dict:
     # absent keys mean the subsystem never ran and the block is omitted
     # alerts_/history_ (ISSUE 15): the watchtower's own health metrics,
     # same silent-when-absent contract (pinned by the ISSUE 15 meta-test)
+    # runprof_ (ISSUE 17): the runtime profiler's gauges, same contract
     for prefix, block_key in (("serve_", "serve"),
                               ("federation_", "federation"),
                               ("alerts_", "alerts"),
-                              ("history_", "history")):
+                              ("history_", "history"),
+                              ("runprof_", "runprof")):
         block: Dict = {}
         for r in records:
             for k, v in r.items():

@@ -33,6 +33,8 @@ INDEX_HTML = """<!doctype html>
 <li><a href="/api/profile">compiled-step profiles (cost/memory/collectives)</a></li>
 <li><a href="/api/history">metrics history (series index; ?name=&window_s=)</a></li>
 <li><a href="/api/alerts">alert states (rules, hysteresis, exemplars)</a></li>
+<li><a href="/api/profiling">runtime profiler snapshot (step rings, sessions)</a></li>
+<li>POST /api/profiling {"action": "start"|"stop", "steps": N} (on-demand capture session)</li>
 </ul>
 <h2>serving</h2>
 <ul>
@@ -85,6 +87,7 @@ class UiServer:
         self._federation = None
         self._history = None
         self._alerts = None
+        self._runprof = None
         self._generate_timeout_s = 120.0
 
     # ---- telemetry (ISSUE 2: Prometheus + JSON export on the UI port) ----
@@ -145,6 +148,17 @@ class UiServer:
         federation aggregator instead. Read at request time; falls back
         to the process engine (telemetry.alerts.get_engine)."""
         self._alerts = engine
+
+    # ---- runtime profiling (ISSUE 17: runprof control on the UI port) ----
+    def attach_runprof(self, profiler) -> None:
+        """Serve a telemetry.runprof.RunProfiler: GET ``/api/profiling``
+        snapshots the step rings + session state, POST ``/api/profiling``
+        with ``{"action": "start", "steps": N}`` opens an on-demand
+        capture session (409 when one is already live) and ``{"action":
+        "stop"}`` closes it, returning the final dump path. Read at
+        request time; falls back to the process default
+        (telemetry.runprof.get_runprof)."""
+        self._runprof = profiler
 
     # ---- federation (ISSUE 12: the cluster view on the UI port) ----
     def attach_federation(self, aggregator) -> None:
@@ -356,6 +370,17 @@ class UiServer:
                                       for a in states),
                         "alerts": states,
                     })
+                elif url.path == "/api/profiling":
+                    from deeplearning4j_tpu.telemetry import (
+                        runprof as _runprof_mod,
+                    )
+
+                    prof = ui._runprof or _runprof_mod.get_runprof()
+                    if prof is None:
+                        self._json({"error": "no runtime profiler "
+                                    "attached"}, 404)
+                        return
+                    self._json(prof.snapshot())
                 elif url.path == "/api/serve":
                     if ui._engine is None:
                         self._json({"error": "no decode engine attached"},
@@ -445,6 +470,9 @@ class UiServer:
 
             def do_POST(self):
                 url = urlparse(self.path)
+                if url.path == "/api/profiling":
+                    self._post_profiling()
+                    return
                 if url.path != "/api/generate":
                     self._json({"error": "not found"}, 404)
                     return
@@ -510,6 +538,55 @@ class UiServer:
                     headers = {"traceparent":
                                _trace.format_traceparent(sp.context())}
                 self._json(resp, extra_headers=headers)
+
+            def _post_profiling(self):
+                """ISSUE 17: on-demand profiling session control. A
+                second ``start`` while one session is live is a 409 (the
+                profiler enforces one-at-a-time); ``stop`` with no live
+                session answers ``{"stopped": null}`` (idempotent, like
+                ``RunProfiler.stop_session``)."""
+                from deeplearning4j_tpu.telemetry import (
+                    runprof as _runprof_mod,
+                )
+
+                prof = ui._runprof or _runprof_mod.get_runprof()
+                if prof is None:
+                    # arm the process default on demand: the operator
+                    # POSTing start expects a profiler to exist
+                    prof = _runprof_mod.default_runprof()
+                payload = self._read_json_body()
+                if payload is None:
+                    return
+                if not isinstance(payload, dict):
+                    self._json({"error": "body must be a JSON object"},
+                               400)
+                    return
+                action = payload.get("action")
+                if action == "start":
+                    try:
+                        steps = int(payload.get("steps", 0))
+                    except (TypeError, ValueError):
+                        self._json({"error": "steps must be an integer"},
+                                   400)
+                        return
+                    if steps < 0:
+                        self._json({"error": "steps must be >= 0"}, 400)
+                        return
+                    try:
+                        sid = prof.start_session(steps=steps)
+                    except RuntimeError as exc:
+                        self._json({"error": str(exc)}, 409)
+                        return
+                    except OSError as exc:
+                        self._json({"error": f"cannot open session "
+                                    f"dump: {exc}"}, 500)
+                        return
+                    self._json({"session": sid, "steps": steps})
+                elif action == "stop":
+                    self._json({"stopped": prof.stop_session()})
+                else:
+                    self._json({"error": "action must be 'start' or "
+                                "'stop'"}, 400)
 
         return Handler
 

@@ -100,6 +100,23 @@ def _heartbeat_history(age_s):
     return h, T0
 
 
+def _climbing_gauge(name, slope_per_s, until_s=60.0, dt=5.0):
+    """History with a gauge climbing ``slope_per_s`` from T0 to
+    T0+until_s, sampled every ``dt``; now = T0+10. Samples extend PAST
+    the returned now because step_time_regression's ``for_s`` (45)
+    outlasts its delta window (30) — the driver's second evaluation at
+    now+for_s reads a window the growth must still be filling (exactly
+    the sustained-growth shape the rule is sized for)."""
+    h, reg = _hist()
+    g = reg.gauge(name)
+    t = 0.0
+    while t <= until_s:
+        g.set(slope_per_s * t)
+        h.sample_once(now=T0 + t)
+        t += dt
+    return h, T0 + 10.0
+
+
 # Every default rule's (firing, non-firing) history builders, each
 # returning (history, now). The meta-test below pins this dict against
 # the live pack, so a new rule cannot ship without both fixtures.
@@ -148,6 +165,24 @@ RULE_FIXTURES = {
     "serve_spec_accept_collapse": (
         lambda: _two_sample_gauge("serve_spec_accept_rate", 0.01, 0.01),
         lambda: _two_sample_gauge("serve_spec_accept_rate", 0.6, 0.6),
+    ),
+    # ISSUE 17 runprof rules. step_time_regression fires only on growth
+    # that outlasts its 30s delta window (20 ms/s sustained for 60s);
+    # quiet = a flat measured step time. The threshold gauges fire on a
+    # collapsed MFU / high input-wait fraction, quiet on healthy values.
+    "step_time_regression": (
+        lambda: _climbing_gauge("runprof_step_ms", 20.0),
+        lambda: _two_sample_gauge("runprof_step_ms", 120.0, 120.0),
+    ),
+    "mfu_collapse": (
+        lambda: _two_sample_gauge("runprof_measured_mfu", 0.001, 0.001),
+        lambda: _two_sample_gauge("runprof_measured_mfu", 0.3, 0.3),
+    ),
+    "input_wait_high": (
+        lambda: _two_sample_gauge("runprof_input_wait_fraction",
+                                  0.6, 0.6),
+        lambda: _two_sample_gauge("runprof_input_wait_fraction",
+                                  0.05, 0.05),
     ),
 }
 
@@ -224,8 +259,30 @@ class TestDefaultRulePack:
         h.sample_once(now=T0 + 120.0)
         for st in eng.evaluate_once(now=T0 + 120.0, publish=False):
             if st["rule"] in ("serve_cache_hit_rate_low",
-                              "serve_spec_accept_collapse"):
+                              "serve_spec_accept_collapse",
+                              "mfu_collapse"):
                 assert st["state"] == "inactive", st
+
+    def test_step_time_one_off_jump_never_fires(self):
+        """The birth/step-change shape step_time_regression is sized
+        against (for_s > window_s): a single jump — a gauge born at a
+        real value, or one slow step — satisfies the delta rule only
+        while the jump is inside the 30s window; the 45s hysteresis
+        outlasts it, so only SUSTAINED growth pages."""
+        h, reg = _hist()
+        g = reg.gauge("runprof_step_ms")
+        g.set(20.0)
+        h.sample_once(now=T0)
+        g.set(220.0)  # one-off jump, then flat
+        for t in range(10, 121, 10):
+            h.sample_once(now=T0 + t)
+        rule = [r for r in default_rules()
+                if r.name == "step_time_regression"][0]
+        assert _drive(rule, h, T0 + 10.0) in ("inactive", "pending")
+        eng = AlertEngine(h, rules=[rule], registry=MetricsRegistry())
+        for t in (10.0, 30.0, 60.0, 90.0, 120.0):
+            states = eng.evaluate_once(now=T0 + t, publish=False)
+        assert states[0]["fire_count"] == 0
 
 
 class TestRuleValidation:

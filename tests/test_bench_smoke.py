@@ -484,7 +484,8 @@ def test_bench_observability_stage_on_cpu():
     assert hist["series"] > 0
     assert hist["serve_tokens_rate_per_s"] > 0   # live rate query worked
     al = sd["alerts"]
-    assert al["rules"] == 10  # default pack incl. the ISSUE 16 serve rules
+    assert al["rules"] == 13  # default pack incl. ISSUE 16 serve rules
+    # + the ISSUE 17 runprof rules
     # a healthy run pages nobody
     assert al["quiet_run_firing"] == []
     # the injected-fault demo fired BOTH demo rules deterministically...
@@ -495,6 +496,60 @@ def test_bench_observability_stage_on_cpu():
     assert al["report_fired"] == ["nonfinite_step_rate",
                                   "serve_latency_slo_burn"]
     # the armed-watch overhead budget, with the shared noise retry
+    if sd["overhead_pct"] >= 5.0:  # noise-floor retry, see docstring
+        sd = run_stage()
+    assert sd["overhead_pct"] < 5.0, sd
+
+
+def test_bench_runprof_stage_on_cpu():
+    """ISSUE 17 acceptance: the runprof stage runs end to end on the CPU
+    backend — the SAME open-loop serve run with the runprof seam timing
+    every scheduler tick costs <5% tokens/s (shared noise retry), the
+    armed run's streaming gauges carry real values, the composed-LM
+    measured-MFU cross-check holds (runprof_measured_mfu — fenced device
+    seconds — is >= the wall-clock MFU, within the documented band the
+    tier-1 test pins), and the N-step capture session round-trips
+    through load_session + the profile_report runtime renderer."""
+
+    def run_stage():
+        env = dict(os.environ)
+        env["BENCH_FORCE_CPU"] = "1"
+        env["BENCH_FAST"] = "1"
+        env["BENCH_BUDGET_SEC"] = "240"
+        env["BENCH_ONLY"] = "runprof"
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True, text=True, timeout=300, cwd=REPO, env=env,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        det = json.loads(out.stdout.strip().splitlines()[-1])["detail"]
+        assert det.get("runprof_overhead_pct") is not None, det.get(
+            "runprof_status")
+        # the cross-check MFU is lifted to its own tracked row
+        assert det.get("runprof_measured_mfu") is not None
+        return det["runprof_detail"]
+
+    sd = run_stage()
+    # stable structure (no retry needed)
+    assert sd["tokens_per_sec"] > 0
+    assert sd["tokens_per_sec_runprof"] > 0
+    g = sd["serve_gauges"]
+    assert g["runprof_steps_total"] > 0      # ticks really flushed
+    assert g["runprof_step_ms"] > 0
+    assert g["runprof_steps_per_s"] > 0
+    # the measured-MFU cross-check: fenced device wall <= wall clock,
+    # so measured >= wall; and both are real nonzero numbers
+    assert sd["measured_mfu"] > 0
+    assert sd["wall_mfu"] > 0
+    assert sd["measured_vs_wall_mfu"] >= 1.0, sd
+    # session -> report chain
+    sess = sd["session"]
+    assert sess["steps"] == sd["lm_steps"]
+    assert sess["partial"] is False
+    assert sess["chrome_events"] > 0
+    assert sess["session_mfu"] > 0
+    assert sess["report_rendered"] is True
+    # the armed-seam overhead budget, with the shared noise retry
     if sd["overhead_pct"] >= 5.0:  # noise-floor retry, see docstring
         sd = run_stage()
     assert sd["overhead_pct"] < 5.0, sd

@@ -407,10 +407,12 @@ def env_read(ctx: ModuleContext) -> Iterable[Finding]:
     keys under the documented ``DL4J_TPU_*`` namespace — currently
     ``DL4J_TPU_ATTN_IMPL`` (ops/flash_attention.py attention-core chain),
     ``DL4J_TPU_MOE_IMPL`` (parallel/moe.py dispatch chain:
-    alltoall | alltoall_2d | replicated), and
+    alltoall | alltoall_2d | replicated),
     ``DL4J_TPU_UPDATE_SHARDING`` (optimize/updaters.py ZeRO
-    update-sharding chain), all read host-side at trace/resolve time,
-    never inside a traced body). Ad-hoc env reads are invisible config:
+    update-sharding chain), and ``DL4J_TPU_RUNPROF`` /
+    ``DL4J_TPU_RUNPROF_DIR`` (telemetry/runprof.py ``runprof=`` seam
+    default + session dump directory), all read host-side at
+    trace/resolve time, never inside a traced body). Ad-hoc env reads are invisible config:
     they fork behavior between hosts and leak into traced code paths
     where a retrace won't see the change."""
     if ctx.path.replace("\\", "/").rsplit("/", 1)[-1] in _BLESSED_FILES:

@@ -4,6 +4,7 @@ per-stage roofline/attribution report (ISSUE 9).
 
 Usage:
     python tools/profile_report.py [--dir REPO] [--json] [--round N]
+                                   [--runtime PATH]
 
 Data source: the ``BENCH_r*.json`` driver artifacts (same files
 tools/bench_report.py reads). Since ISSUE 9 the ``lm_composed`` stage and
@@ -27,6 +28,16 @@ tool renders, for the selected round (default: latest with blobs):
   factorization's per-op shape change — one flat all-to-all becoming two
   smaller-group definitions — shows up in the trajectory, not just the
   aggregate wire total.
+
+``--runtime PATH`` (ISSUE 17) adds a **runtime sessions** section next
+to the AOT roofline: PATH is a runprof session dump (``.json`` final or
+``.jsonl`` write-ahead of a killed session) or a directory of them.
+Each session renders its measured phase breakdown (host / dispatch /
+device / comm-wait / input-wait means, wall p50/p95), steps/s, and
+measured MFU; a reconstructed partial dump is flagged ``PARTIAL`` with
+its torn-line count — the measured half beside the modeled half, so
+"the model says compute-bound" and "the run spent 40% in host" sit in
+one report.
 
 Exit code 0 with "no profile blobs" when the rounds predate ISSUE 9 —
 missing data is reported, never invented.
@@ -191,6 +202,61 @@ def build_report(rounds: List[Dict],
     }
 
 
+def load_runtime_sessions(path: str) -> List[Dict]:
+    """ISSUE 17: runprof session dumps for the ``--runtime`` section —
+    a directory is scanned (finals preferred, killed sessions
+    reconstructed from their JSONL write-ahead), a file loaded directly."""
+    sys.path.insert(0, REPO_ROOT)
+    from deeplearning4j_tpu.telemetry.runprof import (  # noqa: E402
+        find_sessions,
+        load_session,
+    )
+
+    if os.path.isdir(path):
+        return find_sessions(path)
+    return [load_session(path)]
+
+
+def render_runtime_text(sessions: List[Dict]) -> str:
+    if not sessions:
+        return ("no runtime sessions found — capture one with "
+                "POST /api/profiling or DL4J_TPU_RUNPROF=<N>")
+    lines = ["", "runtime sessions (measured step phases):"]
+    for sess in sessions:
+        summ = sess.get("summary") or {}
+        flags = ""
+        if sess.get("partial"):
+            flags = (f"  PARTIAL (reconstructed write-ahead, "
+                     f"{sess.get('torn_lines', 0)} torn lines)")
+        lines.append(f"  session {sess.get('session')}: "
+                     f"{summ.get('steps', 0)} steps{flags}")
+        if not summ.get("steps"):
+            continue
+        wall = summ.get("wall_ms") or {}
+        lines.append(
+            f"    wall {wall.get('mean', 0):.3f}ms mean / "
+            f"{wall.get('p50', 0):.3f} p50 / {wall.get('p95', 0):.3f} p95"
+            + (f", {summ['steps_per_s']:.1f} steps/s"
+               if summ.get("steps_per_s") is not None else ""))
+        lines.append(
+            "    phases: " + ", ".join(
+                f"{key[:-len('_ms_mean')]} {summ.get(key, 0):.3f}ms"
+                for key in ("host_ms_mean", "dispatch_ms_mean",
+                            "device_ms_mean", "comm_wait_ms_mean",
+                            "input_wait_ms_mean")))
+        bits = []
+        if summ.get("host_fraction") is not None:
+            bits.append(f"host frac {summ['host_fraction']:.4f}")
+        if summ.get("input_wait_fraction") is not None:
+            bits.append(f"input-wait frac "
+                        f"{summ['input_wait_fraction']:.4f}")
+        if summ.get("measured_mfu") is not None:
+            bits.append(f"measured MFU {summ['measured_mfu']:.4f}")
+        if bits:
+            lines.append("    " + ", ".join(bits))
+    return "\n".join(lines)
+
+
 def render_text(report: Dict) -> str:
     if not report["stages"]:
         return ("no profile blobs found in any BENCH_r*.json — rounds "
@@ -274,6 +340,10 @@ def main(argv=None) -> int:
                     help="emit the report as JSON")
     ap.add_argument("--round", type=int, default=None,
                     help="render this round's blobs (default: latest)")
+    ap.add_argument("--runtime", default=None, metavar="PATH",
+                    help="runprof session dump (.json/.jsonl) or a "
+                         "directory of them — renders the measured "
+                         "runtime sections next to the AOT roofline")
     args = ap.parse_args(argv)
     rounds = load_profile_rounds(args.dir)
     try:
@@ -281,10 +351,20 @@ def main(argv=None) -> int:
     except ValueError as exc:
         print(str(exc), file=sys.stderr)
         return 2
+    sessions = None
+    if args.runtime is not None:
+        try:
+            sessions = load_runtime_sessions(args.runtime)
+        except OSError as exc:
+            print(f"cannot read runtime sessions: {exc}", file=sys.stderr)
+            return 2
+        report["runtime_sessions"] = sessions
     if args.json:
         print(json.dumps(report, indent=1))
     else:
         print(render_text(report))
+        if sessions is not None:
+            print(render_runtime_text(sessions))
     return 0
 
 

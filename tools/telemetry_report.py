@@ -89,11 +89,13 @@ def format_report(summary: dict, path: str) -> str:
     # otherwise — both directions pinned by the ISSUE 12 meta-test, so a
     # new metric under either prefix can never ship unrendered
     # + alerts/history (ISSUE 15): the watchtower blocks, same contract
+    # + runprof (ISSUE 17): the runtime profiler's gauges, same contract
     for block_key, title in (("serve", "serve metrics (registry)"),
                              ("federation",
                               "federation metrics (registry)"),
                              ("alerts", "alert metrics (registry)"),
-                             ("history", "history metrics (registry)")):
+                             ("history", "history metrics (registry)"),
+                             ("runprof", "runprof metrics (registry)")):
         block = summary.get(block_key)
         if block:
             bw = max(len(k) for k in block)
