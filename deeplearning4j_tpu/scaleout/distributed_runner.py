@@ -70,8 +70,12 @@ class DistributedWorker:
             while not stop.is_set():
                 hb.increment(f"hb.{self.worker_id}")
                 stop.wait(self.heartbeat_s)
-        except (ConnectionError, OSError):
-            return  # master gone; main loop will notice too
+        except (ConnectionError, OSError) as exc:
+            # master gone; main loop will notice too — but a silent dead
+            # heartbeat is indistinguishable from a healthy idle one
+            log.warning("worker %s heartbeat loop died: %r",
+                        self.worker_id, exc)
+            return
         finally:
             hb.close()
 

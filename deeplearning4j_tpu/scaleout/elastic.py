@@ -552,14 +552,21 @@ class ElasticWorker:
         # never delay the liveness signal the master watches
         try:
             hb = self._connect()
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError) as exc:
+            # from here the worker runs with NO liveness signal: the
+            # master WILL evict it at the stale deadline. Say so.
+            log.warning("worker %s heartbeat connect failed: %r; running "
+                        "without a liveness signal", self.worker_id, exc)
             return
         try:
             while not stop.is_set():
                 hb.increment(f"hb.{self.worker_id}")
                 stop.wait(self.heartbeat_s)
-        except (ConnectionError, OSError):
-            return  # TrackerUnavailable included; master will see us stale
+        except (ConnectionError, OSError) as exc:
+            # TrackerUnavailable included; master will see us stale
+            log.warning("worker %s heartbeat loop died: %r",
+                        self.worker_id, exc)
+            return
         finally:
             hb.close()
 

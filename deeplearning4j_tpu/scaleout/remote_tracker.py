@@ -63,6 +63,7 @@ from deeplearning4j_tpu.scaleout.statetracker import (
     StateTracker,
 )
 from deeplearning4j_tpu.telemetry import trace as _trace
+from deeplearning4j_tpu.utils import netwatch
 from deeplearning4j_tpu.utils.lockwatch import make_lock
 
 _HDR = struct.Struct(">I")
@@ -97,6 +98,11 @@ _IDEMPOTENT = frozenset({
     "count", "counters_snapshot", "finish", "is_done",
     "set_best_loss", "best_loss", "early_stop", "is_early_stop",
 })
+
+# Every RPC method must be classified one way or the other: a new method
+# in neither set is a retry-policy decision nobody made, and both the
+# ``nonidempotent-retry`` lint and ``_call_locked`` reject it.
+_NONIDEMPOTENT = frozenset({"increment", "clear_updates"})
 
 
 def _send_frame(sock: socket.socket, obj: Any) -> None:
@@ -163,12 +169,23 @@ class StateTrackerServer:
     Hazelcast member). ``tracker`` is the embedded handle — the master-side
     code uses it directly with zero IPC."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 handler_timeout_s: float = 300.0):
         self.tracker = _VersionedTracker()
+        self.handler_timeout_s = handler_timeout_s
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                # A dead client must not pin this handler thread forever
+                # (the PR 10 deflake documented exactly this class):
+                # bound every recv, generously enough that an idle but
+                # alive client at the repo's poll cadences never trips
+                # it. ``socket.timeout`` is an OSError, so expiry takes
+                # the same client-went-away exit below.
+                self.request = netwatch.wrap_socket(
+                    self.request, "tracker.server.handler")
+                self.request.settimeout(outer.handler_timeout_s)
                 try:
                     while True:
                         frame = _recv_frame(self.request)
@@ -189,8 +206,9 @@ class StateTrackerServer:
                             if sp is not None:
                                 sp.end(error=e)
                             _send_frame(self.request, (False, e))
+                # graftlint: allow[swallowed-thread-exception] a transport fault here IS the handler's normal exit: the client disconnected (or idled past handler_timeout_s) and its state stays in the grid
                 except (ConnectionError, EOFError, OSError):
-                    return  # client went away; its state stays in the grid
+                    return
 
         class Server(socketserver.ThreadingTCPServer):
             daemon_threads = True
@@ -258,7 +276,7 @@ class StateTrackerClient(StateTracker):
                                         timeout=self._connect_timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         sock.settimeout(self._request_timeout_s)
-        self._sock = sock
+        self._sock = netwatch.wrap_socket(sock, "tracker.client")
 
     def _drop_socket(self) -> None:
         if self._sock is not None:
@@ -272,6 +290,7 @@ class StateTrackerClient(StateTracker):
         if self._sock is None:
             self._connect()
             self._registry.counter("tracker_reconnects_total").inc()
+            netwatch.record_reconnect("tracker.client")
             if span is not None:
                 span.add_event("reconnect")
         if span is not None:
@@ -301,12 +320,18 @@ class StateTrackerClient(StateTracker):
         frame — closes the socket; idempotent methods then retry on a
         fresh connection, everything else surfaces ``TrackerUnavailable``
         immediately (see ``_IDEMPOTENT``)."""
+        if method not in _IDEMPOTENT and method not in _NONIDEMPOTENT:
+            raise ValueError(
+                f"tracker RPC {method!r} has no idempotency classification; "
+                "add it to _IDEMPOTENT or _NONIDEMPOTENT (this decides its "
+                "retry policy — see the nonidempotent-retry lint)")
         attempts = (self._retries + 1) if method in _IDEMPOTENT else 1
         last_exc: Optional[BaseException] = None
         with self._lock:
             for attempt in range(attempts):
                 if attempt:
                     self._registry.counter("tracker_retries_total").inc()
+                    netwatch.record_retry("tracker.client")
                     if span is not None:
                         span.add_event("retry", attempt=attempt,
                                        error=repr(last_exc))

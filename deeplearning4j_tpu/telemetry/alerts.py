@@ -73,12 +73,15 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from deeplearning4j_tpu.utils.lockwatch import make_lock
+
+log = logging.getLogger(__name__)
 
 SCHEMA = "dl4j-tpu-alerts-v1"
 ALERT_KV_PREFIX = "federation.alerts."
@@ -259,6 +262,7 @@ class AlertEngine:
         self._states: Dict[str, _RuleState] = {
             r.name: _RuleState(r) for r in self.rules}
         self._seq = 0
+        self._publish_fail_streak = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.registry.gauge("alerts_rules").set(float(len(self.rules)))
@@ -366,9 +370,9 @@ class AlertEngine:
         if fh is not None:
             try:
                 fh.write(json.dumps({"schema": SCHEMA, **tr}) + "\n")
+            # graftlint: allow[swallowed-thread-exception] deliberate: a full disk / just-closed log degrades the transition log, never the run (the alert itself already fired through the gauge + tracer above)
             except (OSError, ValueError):
-                pass  # a full disk / just-closed log degrades the log,
-                #       never the run
+                pass
 
     # ------------------------------------------------------------- surface ----
     def states(self, now: Optional[float] = None) -> List[Dict]:
@@ -438,9 +442,19 @@ class AlertEngine:
         try:
             self.tracker.put_kv(ALERT_KV_PREFIX + self.process,
                                 json.dumps(payload))
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError) as exc:
             self.registry.counter("alerts_publish_failures_total").inc()
+            self._publish_fail_streak += 1
+            if self._publish_fail_streak == 1:
+                # once per outage, not once per interval: an unpublished
+                # alert stream is a blind fleet and nobody would know
+                log.warning("alert publish for %s failing (tracker "
+                            "unreachable): %r", self.process, exc)
             return False
+        if self._publish_fail_streak:
+            log.info("alert publish for %s recovered after %d failure(s)",
+                     self.process, self._publish_fail_streak)
+            self._publish_fail_streak = 0
         self.registry.counter("alerts_publishes_total").inc()
         return True
 

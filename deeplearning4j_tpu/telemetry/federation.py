@@ -48,12 +48,15 @@ meta-test discipline as the ``serve_*`` metrics.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from deeplearning4j_tpu.utils.lockwatch import make_lock
+
+log = logging.getLogger(__name__)
 
 SCHEMA = "dl4j-tpu-fedmetrics-v1"
 KV_PREFIX = "federation.metrics."
@@ -85,6 +88,7 @@ class MetricsPusher:
         self.interval_s = float(interval_s)
         self._lock = make_lock("federation.pusher")  # lockwatch seam
         self._seq = 0
+        self._fail_streak = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -104,11 +108,21 @@ class MetricsPusher:
         try:
             self._tracker.put_kv(KV_PREFIX + self.process,
                                  json.dumps(payload))
-        except (ConnectionError, OSError):
-            # absorbed: freshness degrades, the pushing process survives
+        except (ConnectionError, OSError) as exc:
+            # absorbed: freshness degrades, the pushing process survives —
+            # but say so once per outage (the counter alone is invisible
+            # until someone scrapes it), not once per interval
             self.registry.counter("federation_push_failures_total").inc()
             self.registry.gauge("federation_last_push_error").set(1.0)
+            self._fail_streak += 1
+            if self._fail_streak == 1:
+                log.warning("federation push for %s failing (tracker "
+                            "unreachable): %r", self.process, exc)
             return False
+        if self._fail_streak:
+            log.info("federation push for %s recovered after %d "
+                     "failure(s)", self.process, self._fail_streak)
+            self._fail_streak = 0
         self.registry.counter("federation_pushes_total").inc()
         self.registry.gauge("federation_last_push_unix").set(payload["ts"])
         self.registry.gauge("federation_last_push_error").set(0.0)

@@ -124,8 +124,9 @@ class MetricsHistory:
             seq = self._samples
         rec = {"schema": SCHEMA, "ts": ts, "seq": seq, "snapshot": snap}
         if fh is not None:
-            try:  # a full disk degrades history, never the watched run
+            try:
                 fh.write(json.dumps(rec) + "\n")
+            # graftlint: allow[swallowed-thread-exception] deliberate: a full disk / just-closed spill degrades history, never the watched run; the in-memory rings below still ingest the sample
             except (OSError, ValueError):
                 pass
         with self._lock:

@@ -199,11 +199,14 @@ def summarize_step_log(records: List[Dict]) -> Dict:
     # alerts_/history_ (ISSUE 15): the watchtower's own health metrics,
     # same silent-when-absent contract (pinned by the ISSUE 15 meta-test)
     # runprof_ (ISSUE 17): the runtime profiler's gauges, same contract
+    # netwatch_ (ISSUE 18): per-endpoint socket-watch counters
+    # (utils.netwatch.metrics_record), same contract
     for prefix, block_key in (("serve_", "serve"),
                               ("federation_", "federation"),
                               ("alerts_", "alerts"),
                               ("history_", "history"),
-                              ("runprof_", "runprof")):
+                              ("runprof_", "runprof"),
+                              ("netwatch_", "netwatch")):
         block: Dict = {}
         for r in records:
             for k, v in r.items():

@@ -63,6 +63,7 @@ side (what bench.py's profile blobs do, with both numbers recorded).
 from __future__ import annotations
 
 import json
+import logging
 import re
 import threading
 
@@ -70,6 +71,8 @@ from deeplearning4j_tpu.utils.lockwatch import make_lock
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
+
+log = logging.getLogger(__name__)
 
 __all__ = [
     "CollectiveOp",
@@ -586,10 +589,11 @@ class MemoryWatermarkSampler:
         while not self._stop.wait(self.interval_s):
             try:
                 self.sample_once()
-            except Exception:
+            except Exception as exc:
                 # a flaky backend stat must never kill the sampler thread;
-                # the samples counter exposes the stall
-                pass
+                # the samples counter exposes the stall — debug, not
+                # warning: some backends flake every interval
+                log.debug("memory watermark sample failed: %r", exc)
 
     def start(self) -> "MemoryWatermarkSampler":
         if self._thread is None:

@@ -525,6 +525,7 @@ class UiServer:
                 except ValueError as exc:  # engine-side validation
                     self._json({"error": str(exc)}, 400)
                     return
+                # graftlint: allow[swallowed-thread-exception] the 503 body IS the report: the timeout is surfaced to the caller, and the engine's own serve metrics count it
                 except TimeoutError:
                     self._json({"error": "generation timed out"}, 503)
                     return

@@ -114,6 +114,58 @@ def test_remote_clear_updates_never_drops_newer_snapshot():
         client.close()
 
 
+def test_dead_client_cannot_pin_handler_thread():
+    """ISSUE 18 satellite: a client that connects and goes silent must
+    not hold its handler thread forever — the handler socket's explicit
+    timeout bounds the blocking recv (the PR 10 lingering-handler
+    class)."""
+    import socket
+    import threading
+
+    server = StateTrackerServer(handler_timeout_s=0.3)
+    try:
+        baseline = threading.active_count()
+        raw = socket.create_connection((server.host, server.port),
+                                       timeout=5)
+        raw.sendall(b"\x00")  # partial frame header, then silence
+        deadline = time.time() + 5
+        grew = False
+        while time.time() < deadline:
+            if threading.active_count() > baseline:
+                grew = True
+                break
+            time.sleep(0.01)
+        assert grew, "handler thread never started"
+        # the dead client's handler must exit at its timeout, not linger
+        deadline = time.time() + 10
+        while threading.active_count() > baseline and time.time() < deadline:
+            time.sleep(0.02)
+        assert threading.active_count() <= baseline, (
+            "dead client pinned its handler thread: "
+            f"{[t.name for t in threading.enumerate()]}")
+        raw.close()
+        # the server still serves fresh clients afterwards
+        client = StateTrackerClient(server.address)
+        client.add_worker("alive")
+        assert client.workers() == ["alive"]
+        client.close()
+    finally:
+        server.shutdown()
+
+
+def test_unclassified_rpc_method_is_rejected():
+    """The idempotency contract is load-bearing at runtime too: a method
+    in neither _IDEMPOTENT nor _NONIDEMPOTENT has no retry policy and
+    must be rejected, not silently given one."""
+    with StateTrackerServer() as server:
+        client = StateTrackerClient(server.address)
+        try:
+            with pytest.raises(ValueError, match="idempotency"):
+                client._call("definitely_not_classified")
+        finally:
+            client.close()
+
+
 # ----------------------------------------------------- two-process runner ----
 
 @pytest.mark.parametrize("router_cls", [IterativeReduceWorkRouter,

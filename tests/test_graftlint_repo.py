@@ -125,3 +125,87 @@ def test_concurrency_rules_are_registered():
                  "blocking-under-lock", "unjoined-thread",
                  "condition-wait-no-predicate"):
         assert rule in RULES, rule
+
+
+def test_net_rules_are_registered():
+    """The five ISSUE 18 net/RPC rules ride the same registry/gate."""
+    from tools.graftlint import RULES
+
+    for rule in ("socket-no-timeout", "unbounded-retry",
+                 "retry-no-backoff", "swallowed-thread-exception",
+                 "nonidempotent-retry"):
+        assert rule in RULES, rule
+
+
+# ----------------------------------------- baseline hygiene (ISSUE 18) ----
+
+_PLANTED = ("import jax\n\n"
+            "@jax.jit\n"
+            "def step(x):\n"
+            "    return float(x.sum())\n")
+
+
+def test_update_baseline_prunes_dead_entries(tmp_path):
+    """An entry whose file is gone or whose rule was unregistered can
+    never match again — --update-baseline drops it and says so."""
+    bad = tmp_path / "planted.py"
+    bad.write_text(_PLANTED)
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps({"version": 1, "entries": [
+        {"rule": "jit-host-sync",
+         "path": "deeplearning4j_tpu/definitely_gone.py",
+         "snippet": "float(", "why": "covered code that was deleted"},
+        {"rule": "retired-rule-id", "path": "bench.py",
+         "snippet": "anything", "why": "covered a rule since removed"},
+    ]}))
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "lint_gate.py"),
+         "--baseline", str(baseline), "--update-baseline", str(bad)],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "no longer exists" in out.stdout
+    assert "no longer registered" in out.stdout
+    assert "2 dead entr(ies) pruned" in out.stdout
+    entries = json.loads(baseline.read_text())["entries"]
+    assert all(e["rule"] != "retired-rule-id" for e in entries)
+    assert all("definitely_gone" not in e["path"] for e in entries)
+    # the planted finding got a seeded FIXME entry in the same pass
+    assert any(e["why"].startswith("FIXME") for e in entries)
+
+
+def test_json_reports_per_finding_baseline_status(tmp_path):
+    """--json pins the CI contract: rule/path/line/message per finding,
+    baselined findings separated with their why, and the exit code
+    mirrored in the payload."""
+    bad = tmp_path / "planted.py"
+    bad.write_text(_PLANTED)
+    cli = [sys.executable, os.path.join(REPO_ROOT, "tools", "lint_gate.py")]
+    out = subprocess.run(
+        cli + ["--json", "--baseline", str(tmp_path / "absent.json"),
+               str(bad)],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
+    assert out.returncode == 1
+    payload = json.loads(out.stdout)
+    assert payload["exit_code"] == 1
+    hits = [f for f in payload["findings"]
+            if f["rule"] == "jit-host-sync"]
+    assert hits, payload["findings"]
+    f = hits[0]
+    assert f["path"].endswith("planted.py")
+    assert isinstance(f["line"], int) and f["line"] > 0
+    assert f["message"]
+    assert payload["baselined_findings"] == []
+    # baselined: same finding flips lists, carries its why, gate passes
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps({"version": 1, "entries": [
+        {"rule": "jit-host-sync", "path": f["path"],
+         "snippet": "float(", "why": "pinned for the test"}]}))
+    out = subprocess.run(
+        cli + ["--json", "--baseline", str(baseline), str(bad)],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["exit_code"] == 0
+    assert payload["findings"] == []
+    assert [b["baseline_why"] for b in payload["baselined_findings"]
+            if b["rule"] == "jit-host-sync"] == ["pinned for the test"]

@@ -455,6 +455,76 @@ BAD_FIXTURES = {
                 self._ready.wait(0.5)      # result discarded
                 return self.item
     """,
+    "socket-no-timeout": """
+        import socket
+        import threading
+
+        class Poller:
+            def start(self):
+                self._thread = threading.Thread(target=self._loop,
+                                                daemon=True)
+                self._thread.start()
+
+            def _loop(self):
+                sock = socket.socket()
+                sock.connect(("127.0.0.1", 9000))  # no timeout anywhere
+                return sock.recv(1024)
+
+            def stop(self):
+                self._thread.join(timeout=10)
+    """,
+    "unbounded-retry": """
+        def fetch(sock):
+            while True:
+                try:
+                    return sock.recv(1024)
+                except ConnectionError:
+                    continue              # dead peer -> infinite spin
+    """,
+    "retry-no-backoff": """
+        def fetch(sock):
+            for attempt in range(5):
+                try:
+                    return sock.recv(1024)
+                except ConnectionError:
+                    continue              # re-enters at CPU speed
+            raise ConnectionError("gave up")
+    """,
+    "swallowed-thread-exception": """
+        import threading
+
+        class Pusher:
+            def start(self):
+                self._thread = threading.Thread(target=self._loop,
+                                                daemon=True)
+                self._thread.start()
+
+            def _loop(self):
+                try:
+                    self._push()
+                except Exception:
+                    pass                  # the pusher dies invisibly
+
+            def _push(self):
+                pass
+
+            def stop(self):
+                self._thread.join(timeout=10)
+    """,
+    "nonidempotent-retry": """
+        _IDEMPOTENT = frozenset({"get_kv", "put_kv"})
+        _NONIDEMPOTENT = frozenset({"increment"})
+
+        class Client:
+            def _call(self, method, *args):
+                return method, args
+
+            def get_kv(self, key):
+                return self._call("get_kv", key)
+
+            def clear_all(self):
+                return self._call("clear_all")  # classified by nobody
+    """,
 }
 
 CLEAN_FIXTURES = {
@@ -605,6 +675,101 @@ CLEAN_FIXTURES = {
                 if not self._ready.wait(0.5):  # result checked
                     raise TimeoutError
                 return self.item
+    """,
+    "socket-no-timeout": """
+        import socket
+        import threading
+
+        from deeplearning4j_tpu.utils import netwatch
+
+        class Poller:
+            def start(self):
+                self._thread = threading.Thread(target=self._loop,
+                                                daemon=True)
+                self._thread.start()
+
+            def _loop(self):
+                sock = socket.create_connection(("127.0.0.1", 9000),
+                                                timeout=5.0)
+                sock.settimeout(5.0)
+                data = sock.recv(1024)
+                watched = netwatch.make_socket("poller.peer")
+                watched.connect(("127.0.0.1", 9001))  # seam: default timed
+                return data + watched.recv(1024)
+
+            def stop(self):
+                self._thread.join(timeout=10)
+    """,
+    "unbounded-retry": """
+        import time
+
+        def fetch(sock):
+            for attempt in range(3):           # attempt budget
+                try:
+                    return sock.recv(1024)
+                except ConnectionError:
+                    time.sleep(0.1 * (attempt + 1))
+            raise ConnectionError("gave up")
+
+        def poll(sock, deadline):
+            while True:
+                if time.monotonic() > deadline:  # deadline guard
+                    raise TimeoutError("poll deadline")
+                try:
+                    return sock.recv(1024)
+                except ConnectionError:
+                    time.sleep(0.05)
+    """,
+    "retry-no-backoff": """
+        import random
+        import time
+
+        def fetch(sock):
+            for attempt in range(5):
+                try:
+                    return sock.recv(1024)
+                except ConnectionError:
+                    time.sleep(0.05 * (2 ** attempt)
+                               * (0.5 + random.random() / 2))
+            raise ConnectionError("gave up")
+    """,
+    "swallowed-thread-exception": """
+        import logging
+        import threading
+
+        log = logging.getLogger(__name__)
+
+        class Pusher:
+            def start(self):
+                self._thread = threading.Thread(target=self._loop,
+                                                daemon=True)
+                self._thread.start()
+
+            def _loop(self):
+                try:
+                    self._push()
+                except Exception as exc:
+                    log.warning("pusher died: %r", exc)
+
+            def _push(self):
+                pass
+
+            def stop(self):
+                self._thread.join(timeout=10)
+    """,
+    "nonidempotent-retry": """
+        _IDEMPOTENT = frozenset({"get_kv", "put_kv"})
+        _NONIDEMPOTENT = frozenset({"increment"})
+
+        class Client:
+            def _call(self, method, *args):
+                return method, args
+
+            def get_kv(self, key):
+                return self._call("get_kv", key)
+
+            def increment(self, key):
+                return self._call("increment", key)
     """,
 }
 
@@ -765,3 +930,181 @@ def test_unjoined_thread_joined_via_list_loop():
             t.join(timeout=30)
     """
     assert "unjoined-thread" not in _rules_hit(src)
+
+
+# ------------------------------------- net rule edge behavior (ISSUE 18) ----
+
+def test_socket_timeout_propagates_through_alias():
+    """`t = s; t.settimeout(5)` times the ONE underlying OS socket —
+    reads through either name are clean."""
+    src = """
+    import socket
+    import threading
+
+    class Poller:
+        def start(self):
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+        def _loop(self):
+            raw = socket.socket()
+            sock = raw
+            sock.settimeout(5.0)
+            return raw.recv(1024)      # timed through the alias
+
+        def stop(self):
+            self._thread.join(timeout=10)
+    """
+    assert "socket-no-timeout" not in _rules_hit(src)
+
+
+def test_socket_timeout_propagates_through_call_params():
+    """A module helper's socket parameter inherits timed-ness from its
+    call sites: untimed at any site -> the helper's reads fire; timed at
+    every site -> clean (the _recv_frame/_recv_exact chain shape)."""
+    bad = """
+    import socket
+    import threading
+
+    def _read(sock):
+        return sock.recv(1024)
+
+    class Poller:
+        def start(self):
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+        def _loop(self):
+            sock = socket.socket()
+            return _read(sock)
+
+        def stop(self):
+            self._thread.join(timeout=10)
+    """
+    assert "socket-no-timeout" in _rules_hit(bad)
+    good = """
+    import socket
+    import threading
+
+    def _read(sock):
+        return sock.recv(1024)
+
+    class Poller:
+        def start(self):
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+        def _loop(self):
+            sock = socket.socket()
+            sock.settimeout(5.0)
+            return _read(sock)
+
+        def stop(self):
+            self._thread.join(timeout=10)
+    """
+    assert "socket-no-timeout" not in _rules_hit(good)
+
+
+def test_netwatch_seam_is_timed_by_construction():
+    """A socket adopted through utils.netwatch.wrap_socket carries the
+    watch's enforced default — timed without a visible settimeout."""
+    src = """
+    import threading
+
+    from deeplearning4j_tpu.utils import netwatch
+
+    class Client:
+        def start(self):
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+        def _loop(self):
+            self._sock = netwatch.wrap_socket(self._dial(), "client")
+            return self._sock.recv(1024)
+
+        def _dial(self):
+            return None
+
+        def stop(self):
+            self._thread.join(timeout=10)
+    """
+    assert "socket-no-timeout" not in _rules_hit(src)
+
+
+def test_setdefaulttimeout_clears_the_module():
+    src = """
+    import socket
+    import threading
+
+    socket.setdefaulttimeout(10.0)
+
+    def _loop():
+        sock = socket.socket()
+        return sock.recv(1024)
+
+    def start():
+        threading.Thread(target=_loop, daemon=True).start()
+    """
+    assert "socket-no-timeout" not in _rules_hit(src)
+
+
+def test_handler_request_socket_needs_timeout():
+    """socketserver handler: self.request IS the accepted socket; a
+    `timeout` class attribute (or an explicit settimeout) times it."""
+    bad = """
+    import socketserver
+
+    class Handler(socketserver.BaseRequestHandler):
+        def handle(self):
+            while True:
+                data = self.request.recv(1024)
+                if not data:
+                    return
+                self.request.sendall(data)
+    """
+    assert "socket-no-timeout" in _rules_hit(bad)
+    good = """
+    import socketserver
+
+    class Handler(socketserver.BaseRequestHandler):
+        timeout = 300
+
+        def handle(self):
+            while True:
+                data = self.request.recv(1024)
+                if not data:
+                    return
+                self.request.sendall(data)
+    """
+    assert "socket-no-timeout" not in _rules_hit(good)
+
+
+def test_foreach_skip_scan_is_not_a_retry():
+    """`except ... : continue` over a collection ADVANCES to the next
+    item — only range()/count() loops (attempt budgets) and while loops
+    are retry-shaped."""
+    src = """
+    def sweep(socks):
+        out = []
+        for sock in socks:
+            try:
+                out.append(sock.recv(1024))
+            except ConnectionError:
+                continue               # next peer, not a re-issue
+        return out
+    """
+    hits = _rules_hit(src)
+    assert "unbounded-retry" not in hits
+    assert "retry-no-backoff" not in hits
+
+
+def test_nonidempotent_contract_only_binds_declaring_modules():
+    src = """
+    class Client:
+        def _call(self, method):
+            return method
+
+        def anything(self):
+            return self._call("anything")   # no contract declared: free
+    """
+    assert "nonidempotent-retry" not in _rules_hit(src)

@@ -1,0 +1,242 @@
+"""ISSUE 18 runtime half: the utils.netwatch socket watchdog — the
+dynamic twin of the graftlint net rules. Pins the seam's zero-cost
+unarmed contract, the enforced default timeout, per-endpoint counters,
+and the blocked-too-long flight-recorder dump."""
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu.telemetry.registry import MetricsRegistry  # noqa: E402
+from deeplearning4j_tpu.utils import netwatch as nw  # noqa: E402
+
+
+@pytest.fixture
+def netwatch():
+    nw.reset()
+    nw.enable(default_timeout_s=0.5, watchdog_s=0.15,
+              registry=MetricsRegistry())
+    yield nw
+    nw.disable()
+    nw.reset()
+
+
+# ---------------------------------------------------------------- seam ----
+
+def test_seam_hands_out_plain_socket_when_off():
+    assert not nw.enabled()
+    sock = nw.make_socket("off.ep")
+    try:
+        assert type(sock) is socket.socket
+    finally:
+        sock.close()
+
+
+def test_wrap_is_identity_when_off():
+    a, b = socket.socketpair()
+    try:
+        assert nw.wrap_socket(a, "off.ep") is a
+    finally:
+        a.close()
+        b.close()
+
+
+def test_seam_hands_out_watched_socket_when_armed(netwatch):
+    sock = nw.make_socket("on.ep")
+    try:
+        assert isinstance(sock, nw.WatchedSocket)
+    finally:
+        sock.close()
+
+
+def test_wrap_adopts_and_is_idempotent(netwatch):
+    a, b = socket.socketpair()
+    try:
+        w = nw.wrap_socket(a, "wrap.ep")
+        assert isinstance(w, nw.WatchedSocket)
+        assert nw.wrap_socket(w, "wrap.ep") is w
+    finally:
+        a.close()
+        b.close()
+
+
+def test_env_var_arms_at_creation(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_NETWATCH", "1")
+    try:
+        sock = nw.make_socket("env.ep")
+        try:
+            assert isinstance(sock, nw.WatchedSocket)
+            assert nw.enabled()
+        finally:
+            sock.close()
+    finally:
+        nw.disable()
+        nw.reset()
+
+
+# ------------------------------------------------- enforced timeout ----
+
+def test_default_timeout_enforced_on_unset_socket(netwatch):
+    a, b = socket.socketpair()
+    w = nw.wrap_socket(a, "tracker.client")
+    try:
+        assert w.gettimeout() == 0.5  # enforced process default
+        t0 = time.perf_counter()
+        with pytest.raises(socket.timeout):
+            w.recv(16)
+        elapsed = time.perf_counter() - t0
+        assert 0.3 < elapsed < 5.0
+    finally:
+        a.close()
+        b.close()
+
+
+def test_owner_timeout_wins_over_default(netwatch):
+    a, b = socket.socketpair()
+    w = nw.wrap_socket(a, "tracker.client")
+    try:
+        w.settimeout(0.1)
+        assert w.gettimeout() == 0.1
+        with pytest.raises(socket.timeout):
+            w.recv(16)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_data_flows_through_watched_pair(netwatch):
+    a, b = socket.socketpair()
+    wa = nw.wrap_socket(a, "pair.a")
+    wb = nw.wrap_socket(b, "pair.b")
+    try:
+        wa.sendall(b"ping")
+        assert wb.recv(16) == b"ping"
+    finally:
+        a.close()
+        b.close()
+
+
+def test_accept_wraps_returned_connection(netwatch):
+    srv = nw.make_socket("srv.listener", socket.AF_INET,
+                         socket.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    cli = socket.create_connection(srv.getsockname(), timeout=5)
+    try:
+        conn, _addr = srv.accept()
+        assert isinstance(conn, nw.WatchedSocket)
+        cli.sendall(b"hi")
+        assert conn.recv(16) == b"hi"
+        conn.close()
+    finally:
+        cli.close()
+        srv.close()
+
+
+def test_disable_quiesces_existing_wrappers(netwatch):
+    a, b = socket.socketpair()
+    w = nw.wrap_socket(a, "quiesce.ep")
+    try:
+        b.sendall(b"x")
+        assert w.recv(1) == b"x"
+        before = nw.summary()["endpoints"]["quiesce.ep"]["ops"]
+        nw.disable()
+        assert w.gettimeout() is None  # enforcement off with the watch
+        b.sendall(b"y")
+        assert w.recv(1) == b"y"  # still a working socket, no recording
+        nw.enable(default_timeout_s=0.5, watchdog_s=0.15)
+        assert nw.summary()["endpoints"]["quiesce.ep"]["ops"] == before
+    finally:
+        a.close()
+        b.close()
+
+
+# ------------------------------------------------ counters + metrics ----
+
+def test_timeout_and_policy_counters_flow_through_registry():
+    reg = MetricsRegistry()
+    nw.reset()
+    nw.enable(default_timeout_s=0.1, watchdog_s=5.0, registry=reg)
+    try:
+        a, b = socket.socketpair()
+        w = nw.wrap_socket(a, "tracker.client")
+        try:
+            with pytest.raises(socket.timeout):
+                w.recv(16)
+        finally:
+            a.close()
+            b.close()
+        nw.record_retry("tracker.client")
+        nw.record_reconnect("tracker.client")
+        labels = {"endpoint": "tracker.client"}
+        assert reg.counter("netwatch_timeouts_total", labels).value == 1
+        assert reg.counter("netwatch_retries_total", labels).value == 1
+        assert reg.counter("netwatch_reconnects_total", labels).value == 1
+        rec = nw.metrics_record()
+        assert rec["netwatch_tracker_client_timeouts"] == 1
+        assert rec["netwatch_tracker_client_retries"] == 1
+        assert rec["netwatch_tracker_client_reconnects"] == 1
+        assert rec["netwatch_tracker_client_wait_ms_max"] > 0
+    finally:
+        nw.disable()
+        nw.reset()
+
+
+def test_policy_hooks_are_noops_unarmed():
+    nw.reset()
+    assert not nw.enabled()
+    nw.record_retry("never.ep")
+    nw.record_reconnect("never.ep")
+    assert nw.summary()["endpoints"] == {}
+
+
+# ----------------------------------------------------------- watchdog ----
+
+def test_stall_dumps_thread_stacks_through_flight_recorder(tmp_path):
+    from deeplearning4j_tpu.telemetry import trace as tr
+
+    nw.reset()
+    nw.enable(default_timeout_s=0.6, watchdog_s=0.15)
+    tracer = tr.Tracer("netwatch-test", trace_dir=str(tmp_path),
+                       registry=MetricsRegistry())
+    prev = tr.set_tracer(tracer)
+    try:
+        a, b = socket.socketpair()
+        w = nw.wrap_socket(a, "stuck.ep")
+        got = []
+
+        def reader():
+            try:
+                w.recv(16)
+            except socket.timeout as exc:
+                got.append(exc)
+
+        t = threading.Thread(target=reader, name="the-reader")
+        t.start()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        a.close()
+        b.close()
+        assert len(got) == 1  # stall still times out after the dump
+        assert nw.summary()["stall_dumps"] == 1  # one artifact per call
+        dump_path = os.path.join(str(tmp_path),
+                                 "flightrec_netwatch-test.json")
+        assert os.path.exists(dump_path)
+        payload = json.load(open(dump_path))
+        assert payload["reason"] == "netwatch_stall"
+        extra = payload["extra"]
+        assert extra["netwatch"]["endpoint"] == "stuck.ep"
+        assert extra["netwatch"]["op"] == "recv"
+        stacks = extra["thread_stacks"]
+        assert any("the-reader" in k for k in stacks), list(stacks)
+    finally:
+        tr.set_tracer(prev)
+        nw.disable()
+        nw.reset()

@@ -928,6 +928,72 @@ class TestLockwatchReport:
         assert "lockwatch (per watched lock)" not in self._run_report(path)
 
 
+class TestNetwatchReport:
+    """ISSUE 18: tools/telemetry_report.py surfaces netwatch_*
+    per-endpoint socket-watch counters as a table section — and stays
+    silent when the log carries none. Pinned off a REAL watched
+    socketpair so the rendered names are the ones metrics_record()
+    actually emits."""
+
+    def _run_report(self, path):
+        import subprocess
+        import sys as _sys
+
+        out = subprocess.run(
+            [_sys.executable,
+             os.path.join(REPO, "tools", "telemetry_report.py"), path],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        return out.stdout
+
+    def test_netwatch_section_rendered(self, tmp_path):
+        import socket
+
+        from deeplearning4j_tpu.utils import netwatch as nw
+
+        nw.reset()
+        nw.enable(registry=MetricsRegistry())
+        try:
+            a, b = socket.socketpair()
+            wa = nw.wrap_socket(a, "report.peer")
+            b.sendall(b"x")
+            assert wa.recv(1) == b"x"
+            nw.record_retry("report.peer")
+            nw.record_reconnect("report.peer")
+            rec = nw.metrics_record()
+        finally:
+            a.close()
+            b.close()
+            nw.disable()
+            nw.reset()
+        assert rec["netwatch_report_peer_ops"] == 1
+        assert rec["netwatch_report_peer_retries"] == 1
+        path = str(tmp_path / "steps.jsonl")
+        with StepLogWriter(path) as w:
+            w.write(0, loss=1.0)
+            w.write(1, loss=0.5, **rec)
+        summary = summarize_step_log(read_step_log(path))
+        assert summary["netwatch"]["netwatch_report_peer_ops"] == 1
+        text = self._run_report(path)
+        assert "netwatch (per watched endpoint)" in text
+        assert "report_peer" in text
+        # meta pin: every stat metrics_record() flattens for an endpoint
+        # has a column in the table, so a record can't ship unrendered
+        header = text.split("netwatch (per watched endpoint)\n")[1]
+        for stat in ("ops", "timeouts", "reconnects", "retries",
+                     "wait"):
+            assert stat in header.splitlines()[0], stat
+
+    def test_silent_without_netwatch_metrics(self, tmp_path):
+        path = str(tmp_path / "steps.jsonl")
+        with StepLogWriter(path) as w:
+            w.write(0, loss=1.0)
+            w.write(1, loss=0.5)
+        assert "netwatch" not in summarize_step_log(read_step_log(path))
+        assert ("netwatch (per watched endpoint)"
+                not in self._run_report(path))
+
+
 class TestServeFederationReport:
     """ISSUE 12 satellite + meta-test: every ``serve_*`` and
     ``federation_*`` registry metric name is rendered by

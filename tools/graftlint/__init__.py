@@ -21,6 +21,8 @@ runs the same gate as a tier-1 test with the checked-in baseline.
 from tools.graftlint.baseline import (  # noqa: F401
     apply_baseline,
     load_baseline,
+    match_entry,
+    prune_baseline,
     write_baseline,
 )
 from tools.graftlint.engine import (  # noqa: F401
@@ -33,4 +35,7 @@ from tools.graftlint.engine import (  # noqa: F401
 from tools.graftlint import rules as _rules  # noqa: F401  (registers RULES)
 from tools.graftlint import (  # noqa: F401  (registers concurrency RULES)
     concurrency_rules as _concurrency_rules,
+)
+from tools.graftlint import (  # noqa: F401  (registers net/RPC RULES)
+    net_rules as _net_rules,
 )

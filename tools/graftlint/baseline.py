@@ -19,7 +19,8 @@ tier-1 gate refuses, so an unjustified allowlist can't land.
 from __future__ import annotations
 
 import json
-from typing import Dict, Iterable, List, Tuple
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from tools.graftlint.engine import Finding
 
@@ -45,6 +46,40 @@ def _matches(entry: Dict, finding: Finding) -> bool:
     return (entry["rule"] == finding.rule
             and entry["path"] == finding.path
             and entry["snippet"] in finding.snippet)
+
+
+def match_entry(entries: List[Dict], finding: Finding) -> Optional[Dict]:
+    """The baseline entry covering ``finding``, or None — the per-finding
+    status the gate's ``--json`` output reports."""
+    for e in entries:
+        if _matches(e, finding):
+            return e
+    return None
+
+
+def prune_baseline(entries: List[Dict], repo_root: Optional[str] = None,
+                   rules: Optional[Set[str]] = None,
+                   ) -> Tuple[List[Dict], List[Dict]]:
+    """Split ``entries`` into (kept, pruned): an entry whose file no
+    longer exists or whose rule is no longer registered can never match
+    a finding again — it is dead weight that would otherwise sit in the
+    allowlist forever looking like a justified exception. Each pruned
+    dict gains a ``pruned_because`` reason for the ``--update-baseline``
+    report."""
+    kept: List[Dict] = []
+    pruned: List[Dict] = []
+    for e in entries:
+        if rules is not None and e["rule"] not in rules:
+            pruned.append(dict(
+                e, pruned_because=f"rule {e['rule']!r} is no longer "
+                "registered"))
+        elif repo_root is not None and not os.path.exists(
+                os.path.join(repo_root, e["path"])):
+            pruned.append(dict(
+                e, pruned_because=f"file {e['path']} no longer exists"))
+        else:
+            kept.append(e)
+    return kept, pruned
 
 
 def apply_baseline(findings: Iterable[Finding], entries: List[Dict],

@@ -1,0 +1,607 @@
+"""Network/RPC hygiene rule set (ISSUE 18): transport faults bounded in
+time, on every thread.
+
+ROADMAP items 1-2 turn one serving process into a routed fleet, which
+multiplies sockets, retry loops, and background RPC threads the same way
+PR 11 anticipated threads multiplying locks. The reference DL4J scaleout
+stack died by a thousand hung sockets and silent retries; these rules
+encode the transport fault model remote_tracker.py already practices —
+every socket carries a timeout, every retry is bounded and backed off,
+every retried method is *declared* idempotent, and no thread swallows
+the exception that killed it.
+
+Each rule rides the per-module :class:`tools.graftlint.threads.
+ThreadModel` (thread-entrypoint reachability, handler classes) plus a
+socket dataflow pass (:class:`NetModel`): which names hold sockets,
+which of those provably carry a timeout (``settimeout``, a
+``create_connection(timeout=...)``, or the ``utils.netwatch``
+``make_socket``/``wrap_socket`` seam — watched sockets get the enforced
+process default), with aliasing through assignment and through in-file
+call parameters. The runtime half — timeouts/retries that only exist at
+run time, stalls on sockets statics cannot see — lives in
+``deeplearning4j_tpu/utils/netwatch.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.graftlint.engine import (
+    Finding,
+    ModuleContext,
+    dotted,
+    last_part,
+    register,
+)
+from tools.graftlint.threads import thread_model
+
+# the utils.netwatch seam: sockets created/wrapped through it carry the
+# watched process default timeout, so they count as timed by construction
+_SEAM_CTORS = {"make_socket", "wrap_socket"}
+_BLOCKING_OPS = {"recv", "recv_into", "accept", "connect", "sendall"}
+# exception types whose catch marks a handler as absorbing a TRANSPORT
+# fault (the retry rules) — or, with the broad pair, swallowing anything
+_TRANSPORT_EXCS = {
+    "OSError", "IOError", "EnvironmentError", "ConnectionError",
+    "ConnectionResetError", "ConnectionRefusedError",
+    "ConnectionAbortedError", "BrokenPipeError", "EOFError",
+    "TimeoutError", "error", "timeout", "herror", "gaierror",
+    "TrackerUnavailable",
+}
+_BROAD_EXCS = {"Exception", "BaseException"}
+# unambiguously-network exceptions: catching one of THESE around a loop
+# body is what marks the loop as a transport retry. OSError/Exception
+# alone stay out — file-IO skip-scans (`except OSError: continue` over a
+# directory listing) are not retries, they advance to the next item.
+_NET_EXCS = {
+    "ConnectionError", "ConnectionResetError", "ConnectionRefusedError",
+    "ConnectionAbortedError", "BrokenPipeError", "EOFError",
+    "TrackerUnavailable", "error", "timeout", "gaierror", "herror",
+}
+# a call whose dotted name carries one of these tokens counts as
+# REPORTING the swallowed exception (logging, flight-recorder dump,
+# printing, explicit failure accounting)
+_REPORT_TOKENS = ("log", "print", "warn", "error", "debug", "info",
+                  "exception", "critical", "dump", "report", "audit")
+_GUARD_TOKENS = ("deadline", "monotonic", "perf_counter", "attempt",
+                 "retr", "tries", "budget", "timeout", "expire",
+                 "give_up")
+_IDEM_NAMES = {"_IDEMPOTENT", "IDEMPOTENT"}
+_NONIDEM_NAMES = {"_NONIDEMPOTENT", "NONIDEMPOTENT",
+                  "_NON_IDEMPOTENT", "NON_IDEMPOTENT"}
+
+
+def _finding(ctx: ModuleContext, rule: str, node: ast.AST, message: str,
+             hint: str) -> Finding:
+    return Finding(rule, ctx.path, node.lineno, message, hint,
+                   ctx.snippet(node.lineno))
+
+
+def _timeout_arg(call: ast.Call) -> Optional[ast.AST]:
+    """The timeout expression of a ``create_connection``-shaped call
+    (second positional or ``timeout=`` keyword), None when absent."""
+    if len(call.args) >= 2:
+        return call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return kw.value
+    return None
+
+
+def _is_none(node: Optional[ast.AST]) -> bool:
+    return node is None or (isinstance(node, ast.Constant)
+                            and node.value is None)
+
+
+# ---------------------------------------------------------------- NetModel ----
+
+class NetModel:
+    """Socket dataflow for one module: which names are socket-valued and
+    which of those provably carry a timeout.
+
+    Timed-ness sources: ``x.settimeout(<non-None>)`` on the name,
+    creation via ``create_connection(..., timeout=...)``, creation
+    through the netwatch seam (``make_socket``/``wrap_socket`` enforce
+    the watched default), or a ``timeout = <n>`` class attribute on a
+    ``StreamRequestHandler``-family handler (``setup()`` applies it to
+    the connection). Propagation: assignment aliasing (both directions —
+    two names, one OS socket) and in-file call parameters (a parameter
+    is timed only when EVERY socket-passing call site passes a timed
+    expression). ``socket.setdefaulttimeout(...)`` at module scope turns
+    the whole module timed.
+    """
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        self.tm = thread_model(ctx)
+        # key: ("local", enclosing_fn_or_None, name) | ("attr", cls, name)
+        self.sockets: Dict[tuple, ast.AST] = {}
+        self.timed: Set[tuple] = set()
+        self.aliases: List[Tuple[tuple, tuple]] = []
+        self.default_timeout = any(
+            isinstance(n, ast.Call)
+            and last_part(n.func) == "setdefaulttimeout"
+            and n.args and not _is_none(n.args[0])
+            for n in ast.walk(ctx.tree))
+        self._collect()
+        self._propagate()
+
+    # -- naming --
+    def key_of(self, node: ast.AST) -> Optional[tuple]:
+        if isinstance(node, ast.Attribute) and isinstance(
+                node.value, ast.Name) and node.value.id == "self":
+            cls = self.tm._scope_class(node)
+            if cls is None:
+                return None
+            return ("attr", cls, node.attr)
+        if isinstance(node, ast.Name):
+            return ("local", self.ctx.enclosing_function(node), node.id)
+        return None
+
+    @staticmethod
+    def render(key: tuple) -> str:
+        return f"self.{key[2]}" if key[0] == "attr" else key[2]
+
+    # -- creation classification --
+    def _ctor(self, call: ast.Call) -> Optional[bool]:
+        """None: not a socket constructor; else the created socket's
+        timed-ness."""
+        lp = last_part(call.func)
+        d = dotted(call.func)
+        if lp in _SEAM_CTORS:
+            return True  # netwatch seam enforces the watched default
+        if d == "socket.socket" or (lp == "socket"
+                                    and isinstance(call.func, ast.Name)):
+            return False
+        if lp == "create_connection":
+            return not _is_none(_timeout_arg(call))
+        return None
+
+    def _collect(self) -> None:
+        ctx = self.ctx
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign):
+                val = node.value
+                if isinstance(val, ast.Call):
+                    timed = self._ctor(val)
+                    if timed is not None:
+                        for tgt in node.targets:
+                            k = self.key_of(tgt)
+                            if k is not None:
+                                self.sockets.setdefault(k, node)
+                                if timed:
+                                    self.timed.add(k)
+                    elif (isinstance(val.func, ast.Attribute)
+                          and val.func.attr == "accept"):
+                        # conn, addr = srv.accept(): the accepted socket
+                        # does NOT inherit the listener's timeout
+                        tgt = node.targets[0]
+                        first = (tgt.elts[0] if isinstance(tgt, ast.Tuple)
+                                 and tgt.elts else None)
+                        k = self.key_of(first) if first is not None else None
+                        if k is not None:
+                            self.sockets.setdefault(k, node)
+                elif isinstance(val, (ast.Name, ast.Attribute)):
+                    vk = self.key_of(val)
+                    if vk is not None:
+                        for tgt in node.targets:
+                            tk = self.key_of(tgt)
+                            if tk is not None and tk != vk:
+                                self.aliases.append((tk, vk))
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "settimeout"
+                    and node.args and not _is_none(node.args[0])):
+                k = self.key_of(node.func.value)
+                if k is not None:
+                    self.sockets.setdefault(k, node)
+                    self.timed.add(k)
+        # server handler classes: self.request/self.connection IS the
+        # accepted socket; a `timeout = <n>` class attribute is applied
+        # by StreamRequestHandler.setup()
+        for cls in self.tm.handler_classes:
+            timed = any(
+                isinstance(stmt, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "timeout"
+                        for t in stmt.targets)
+                and not _is_none(stmt.value)
+                for stmt in cls.body)
+            for attr in ("request", "connection"):
+                k = ("attr", cls, attr)
+                self.sockets.setdefault(k, cls)
+                if timed:
+                    self.timed.add(k)
+
+    # -- propagation --
+    def _param_sites(self) -> Dict[tuple, List[ast.AST]]:
+        """param key -> the argument expressions passed at every in-file
+        call site that binds it."""
+        sites: Dict[tuple, List[ast.AST]] = {}
+        for fn in self.ctx.functions:
+            for call in ast.walk(fn):
+                if not (isinstance(call, ast.Call)
+                        and self.ctx.enclosing_function(call) is fn):
+                    continue
+                for callee in self.tm._resolve_callable(call.func, fn):
+                    params = [a.arg for a in
+                              getattr(callee.args, "args", [])]
+                    start = 1 if params and params[0] in ("self",
+                                                          "cls") else 0
+                    for i, arg in enumerate(call.args):
+                        if start + i < len(params):
+                            sites.setdefault(
+                                ("local", callee, params[start + i]),
+                                []).append(arg)
+                    for kw in call.keywords:
+                        if kw.arg in params:
+                            sites.setdefault(("local", callee, kw.arg),
+                                             []).append(kw.value)
+        return sites
+
+    def _expr_socketness(self, expr: ast.AST
+                         ) -> Tuple[bool, bool]:
+        """(is_socket, is_timed) for an argument expression."""
+        if isinstance(expr, ast.Call):
+            timed = self._ctor(expr)
+            if timed is not None:
+                return True, timed
+            return False, False
+        k = self.key_of(expr)
+        if k is not None and k in self.sockets:
+            return True, k in self.timed
+        return False, False
+
+    def _propagate(self) -> None:
+        sites = self._param_sites()
+        for _ in range(10):
+            changed = False
+            for a, b in self.aliases:  # two names, one OS socket
+                if a in self.sockets or b in self.sockets:
+                    for k, other in ((a, b), (b, a)):
+                        if k not in self.sockets:
+                            self.sockets[k] = self.sockets[other]
+                            changed = True
+                    if (a in self.timed) != (b in self.timed):
+                        self.timed.update((a, b))
+                        changed = True
+            for pkey, exprs in sites.items():
+                socky = [self._expr_socketness(e) for e in exprs]
+                if not any(s for s, _ in socky):
+                    continue
+                if pkey not in self.sockets:
+                    self.sockets[pkey] = pkey[1]
+                    changed = True
+                if pkey not in self.timed and all(
+                        t for s, t in socky if s):
+                    self.timed.add(pkey)
+                    changed = True
+            if not changed:
+                break
+
+
+def net_model(ctx: ModuleContext) -> NetModel:
+    """Get-or-build the module's NetModel (cached on the context, like
+    :func:`tools.graftlint.threads.thread_model`)."""
+    nm = getattr(ctx, "_net_model", None)
+    if nm is None:
+        nm = NetModel(ctx)
+        ctx._net_model = nm
+    return nm
+
+
+# --------------------------------------------------------- socket-no-timeout ----
+
+@register("socket-no-timeout")
+def socket_no_timeout(ctx: ModuleContext) -> Iterable[Finding]:
+    """A blocking socket operation (``recv``/``accept``/``connect``/
+    ``sendall``) on a socket with no provable timeout, reachable from a
+    thread entrypoint or a server handler — a dead peer parks that
+    thread forever (the hung-handler class the PR 10 deflake
+    documented). ``create_connection``/``urlopen`` without a timeout
+    argument on the same paths fire too. Sockets routed through the
+    ``utils.netwatch`` seam are timed by construction (the watch
+    enforces a process default)."""
+    nm = net_model(ctx)
+    if nm.default_timeout:
+        return []
+    tm = nm.tm
+    out: List[Finding] = []
+    for fn in ctx.functions:
+        if fn not in tm.thread_fns:
+            continue
+        for call in ctx.walk_in_function(fn, ast.Call):
+            lp = last_part(call.func)
+            if (isinstance(call.func, ast.Attribute)
+                    and call.func.attr in _BLOCKING_OPS):
+                k = nm.key_of(call.func.value)
+                if (k is not None and k in nm.sockets
+                        and k not in nm.timed):
+                    out.append(_finding(
+                        ctx, "socket-no-timeout", call,
+                        f"socket `{nm.render(k)}`.{call.func.attr}() with "
+                        "no timeout on a thread/handler path — a dead "
+                        "peer blocks this thread forever",
+                        "settimeout() the socket at creation (or route "
+                        "it through utils.netwatch.make_socket/"
+                        "wrap_socket — watched sockets get the enforced "
+                        "process default)"))
+            elif lp == "create_connection" and _is_none(_timeout_arg(call)):
+                out.append(_finding(
+                    ctx, "socket-no-timeout", call,
+                    "create_connection() with no timeout on a thread/"
+                    "handler path — connect to a dead host blocks for "
+                    "the kernel default (minutes)",
+                    "pass timeout= (and settimeout() for the request "
+                    "phase), or create through utils.netwatch."
+                    "make_socket"))
+            elif lp == "urlopen" and not any(
+                    kw.arg == "timeout" for kw in call.keywords):
+                out.append(_finding(
+                    ctx, "socket-no-timeout", call,
+                    "urlopen() with no timeout on a thread/handler path "
+                    "— a stalled HTTP peer parks this thread forever",
+                    "pass timeout= to every urlopen on a background "
+                    "thread"))
+    return out
+
+
+# ------------------------------------------------------- retry loop plumbing ----
+
+def _caught_names(handler: ast.ExceptHandler) -> List[str]:
+    if handler.type is None:
+        return ["<bare>"]
+    nodes = (handler.type.elts if isinstance(handler.type, ast.Tuple)
+             else [handler.type])
+    return [last_part(n) for n in nodes]
+
+
+def _catches_transport(handler: ast.ExceptHandler) -> Optional[str]:
+    names = _caught_names(handler)
+    hit = [n for n in names
+           if n in _TRANSPORT_EXCS or n in _BROAD_EXCS or n == "<bare>"]
+    return "/".join(hit) if hit else None
+
+
+def _catches_network(handler: ast.ExceptHandler) -> Optional[str]:
+    """Network-only: the retry rules key on this narrower set so a
+    file-IO ``except OSError: continue`` scan never reads as a retry."""
+    names = _caught_names(handler)
+    hit = [n for n in names if n in _NET_EXCS]
+    return "/".join(hit) if hit else None
+
+
+def _handler_exits(handler: ast.ExceptHandler) -> bool:
+    """True when the handler leaves the loop (raise/return/break, or a
+    process exit call) instead of re-entering it."""
+    for stmt in handler.body:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Raise, ast.Return, ast.Break)):
+                return True
+            if isinstance(node, ast.Call) and last_part(node.func) in (
+                    "exit", "_exit", "abort"):
+                return True
+    return False
+
+
+def _enclosing_loop(ctx: ModuleContext, node: ast.AST,
+                    fn: ast.AST) -> Optional[ast.AST]:
+    cur = ctx.parents.get(node)
+    while cur is not None and cur is not fn:
+        if isinstance(cur, (ast.While, ast.For)):
+            return cur
+        cur = ctx.parents.get(cur)
+    return None
+
+
+def _loop_unbounded(loop: ast.AST) -> bool:
+    if isinstance(loop, ast.While):
+        return isinstance(loop.test, ast.Constant) and bool(loop.test.value)
+    if isinstance(loop, ast.For):
+        return (isinstance(loop.iter, ast.Call)
+                and last_part(loop.iter.func) == "count")
+    return False
+
+
+def _loop_has_deadline_guard(loop: ast.AST) -> bool:
+    """An ``if`` in the loop that mentions a deadline/attempt-shaped name
+    (or a clock call) and raises/returns/breaks — the bounded-poll idiom
+    (``if time.monotonic() > deadline: raise``)."""
+    for node in ast.walk(loop):
+        if not isinstance(node, ast.If):
+            continue
+        mention = " ".join(
+            dotted(n).lower() for n in ast.walk(node.test)
+            if isinstance(n, (ast.Name, ast.Attribute)))
+        if any(tok in mention for tok in _GUARD_TOKENS):
+            if any(isinstance(x, (ast.Raise, ast.Return, ast.Break))
+                   for x in ast.walk(node)):
+                return True
+    return False
+
+
+def _loop_has_backoff(loop: ast.AST) -> bool:
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Call):
+            lp = last_part(node.func)
+            if lp in ("sleep", "wait") or "backoff" in lp.lower():
+                return True
+    return False
+
+
+def _retry_loops(ctx: ModuleContext):
+    """(loop, handler, caught) for every loop whose nearest Try absorbs a
+    NETWORK exception and re-enters the loop — the retry shape both
+    retry rules police. ``for`` loops only count when iterating
+    ``range()``/``count()`` (an attempt budget): a for-each over a
+    collection that skips a failed item advances, it does not re-issue
+    the same call."""
+    seen = set()
+    for fn in ctx.functions:
+        for node in ctx.walk_in_function(fn, ast.Try):
+            for h in node.handlers:
+                caught = _catches_network(h)
+                if caught is None or _handler_exits(h):
+                    continue
+                loop = _enclosing_loop(ctx, node, fn)
+                if loop is None:
+                    continue
+                if isinstance(loop, ast.For) and not (
+                        isinstance(loop.iter, ast.Call)
+                        and last_part(loop.iter.func) in ("range",
+                                                          "count")):
+                    continue
+                key = (loop.lineno, h.lineno)
+                if key not in seen:
+                    seen.add(key)
+                    yield loop, h, caught
+
+
+# ------------------------------------------------------------ unbounded-retry ----
+
+@register("unbounded-retry")
+def unbounded_retry(ctx: ModuleContext) -> Iterable[Finding]:
+    """A ``while True`` (or ``itertools.count``) loop that catches a
+    transport exception and re-enters with no attempt cap and no
+    deadline: a dead peer turns into an infinite spin instead of a loud,
+    bounded failure. The sanctioned shape is a ``for attempt in
+    range(n)`` budget or a ``monotonic() > deadline`` check that
+    raises (remote_tracker / elastic both practice it)."""
+    out: List[Finding] = []
+    for loop, h, caught in _retry_loops(ctx):
+        if _loop_unbounded(loop) and not _loop_has_deadline_guard(loop):
+            out.append(_finding(
+                ctx, "unbounded-retry", h,
+                f"unbounded retry: `{caught}` is absorbed and the loop "
+                "re-enters with no attempt cap or deadline — a dead "
+                "peer becomes an infinite spin",
+                "bound it: `for attempt in range(n)` with the failure "
+                "raised after the budget, or a deadline check "
+                "(`if time.monotonic() > deadline: raise`) inside the "
+                "loop"))
+    return out
+
+
+# ----------------------------------------------------------- retry-no-backoff ----
+
+@register("retry-no-backoff")
+def retry_no_backoff(ctx: ModuleContext) -> Iterable[Finding]:
+    """A retry loop (bounded or not) that re-enters the call with no
+    sleep/backoff between attempts hammers a struggling peer at CPU
+    speed — the retry storm that turns one slow master into a dead one.
+    Any ``sleep``/``wait``/backoff call inside the loop counts."""
+    out: List[Finding] = []
+    for loop, h, caught in _retry_loops(ctx):
+        if not _loop_has_backoff(loop):
+            out.append(_finding(
+                ctx, "retry-no-backoff", h,
+                f"retry re-enters the call immediately after `{caught}` "
+                "with no sleep/backoff — failures are retried at CPU "
+                "speed against an already-struggling peer",
+                "sleep a jittered, exponentially growing delay between "
+                "attempts (see StateTrackerClient._call_locked)"))
+    return out
+
+
+# ------------------------------------------------- swallowed-thread-exception ----
+
+def _uses_bound_exc(handler: ast.ExceptHandler) -> bool:
+    if handler.name is None:
+        return False
+    return any(isinstance(n, ast.Name) and n.id == handler.name
+               for stmt in handler.body for n in ast.walk(stmt))
+
+
+def _handler_reports(handler: ast.ExceptHandler) -> bool:
+    for stmt in handler.body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call):
+                name = dotted(node.func).lower()
+                if any(tok in name for tok in _REPORT_TOKENS):
+                    return True
+    return False
+
+
+@register("swallowed-thread-exception")
+def swallowed_thread_exception(ctx: ModuleContext) -> Iterable[Finding]:
+    """``except: pass`` (or a log-less broad/transport except) inside a
+    thread entrypoint or anything it reaches: the exception that killed
+    the background pusher is dropped on the floor, and a dead heartbeat
+    becomes an invisible fleet outage. A handler reports by raising,
+    logging/printing/dumping, or keeping the bound exception for later
+    use; a counter alone is not a report — nobody watches a counter they
+    don't know exists."""
+    tm = thread_model(ctx)
+    if not tm.thread_fns:
+        return []
+    out: List[Finding] = []
+    for fn in ctx.functions:
+        if fn not in tm.thread_fns:
+            continue
+        for node in ctx.walk_in_function(fn, ast.Try):
+            for h in node.handlers:
+                caught = _catches_transport(h)
+                if caught is None:
+                    continue
+                if _handler_reports(h) or _uses_bound_exc(h):
+                    continue
+                out.append(_finding(
+                    ctx, "swallowed-thread-exception", h,
+                    f"`{caught}` swallowed with no log on a thread path "
+                    "— the thread dies (or degrades) invisibly",
+                    "log it (log.warning with the exception) before "
+                    "absorbing, or re-raise; if the silence is "
+                    "deliberate, inline-allow with the why"))
+    return out
+
+
+# --------------------------------------------------------- nonidempotent-retry ----
+
+def _declared_strs(node: ast.AST) -> Set[str]:
+    return {n.value for n in ast.walk(node)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)}
+
+
+@register("nonidempotent-retry")
+def nonidempotent_retry(ctx: ModuleContext) -> Iterable[Finding]:
+    """In a module that declares an RPC idempotency contract (a
+    module-level ``_IDEMPOTENT`` set — remote_tracker's retry
+    classification), every method dispatched through ``_call`` must be
+    classified: ``_IDEMPOTENT`` (safe to re-issue after an ambiguous
+    failure) or ``_NONIDEMPOTENT`` (fail fast — a replay could
+    double-apply). An unclassified method means the retry decision was
+    never made, which is how ``increment`` double-counts."""
+    idem: Set[str] = set()
+    nonidem: Set[str] = set()
+    declared = False
+    for stmt in ctx.tree.body:
+        if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)):
+            continue
+        name = stmt.targets[0].id
+        if name in _IDEM_NAMES:
+            idem = _declared_strs(stmt.value)
+            declared = True
+        elif name in _NONIDEM_NAMES:
+            nonidem = _declared_strs(stmt.value)
+    if not declared:
+        return []
+    out: List[Finding] = []
+    for call in ast.walk(ctx.tree):
+        if not (isinstance(call, ast.Call)
+                and last_part(call.func) == "_call" and call.args):
+            continue
+        method = ctx.resolve_str(call.args[0])
+        if method is not None and method not in idem \
+                and method not in nonidem:
+            out.append(_finding(
+                ctx, "nonidempotent-retry", call,
+                f"RPC method {method!r} rides the retry dispatcher but "
+                "is classified neither idempotent nor non-idempotent — "
+                "whether it may be replayed was never decided",
+                "add it to _IDEMPOTENT (safe to re-issue) or "
+                "_NONIDEMPOTENT (fail fast; a replay could "
+                "double-apply) next to the other declarations"))
+    return out

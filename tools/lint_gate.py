@@ -33,6 +33,8 @@ from tools.graftlint import (  # noqa: E402
     apply_baseline,
     lint_paths,
     load_baseline,
+    match_entry,
+    prune_baseline,
     write_baseline,
 )
 from tools.graftlint.baseline import FIXME_WHY  # noqa: E402
@@ -112,11 +114,20 @@ def main(argv=None) -> int:
         if args.rules:
             ap.error("--update-baseline regenerates the FULL baseline; "
                      "it cannot be combined with --rule")
+        from tools.graftlint import RULES
+
         findings = lint_paths(paths or DEFAULT_TARGETS, REPO_ROOT)
         old = load_baseline(args.baseline)
+        # dead entries (file gone / rule unregistered) can never match a
+        # finding again — drop them loudly instead of carrying them
+        old, pruned = prune_baseline(old, REPO_ROOT, set(RULES))
         entries = write_baseline(args.baseline, findings, old)
+        for e in pruned:
+            print(f"pruned: [{e['rule']}] {e['path']}: "
+                  f"{e['pruned_because']}")
         n_fixme = sum(1 for e in entries if e["why"].startswith("FIXME"))
         print(f"baseline: {len(entries)} entries written to {args.baseline}"
+              + (f" ({len(pruned)} dead entr(ies) pruned)" if pruned else "")
               + (f" ({n_fixme} need a why — gate fails until justified)"
                  if n_fixme else ""))
         return 0
@@ -124,11 +135,28 @@ def main(argv=None) -> int:
     fresh, stale, all_findings = run_gate(
         paths, args.baseline, use_baseline=not args.no_baseline,
         rule_ids=args.rules)
+    exit_code = 1 if (fresh or stale) else 0
     if args.as_json:
+        # per-finding baseline status so CI can annotate a diff without
+        # re-deriving the matching: fresh findings fail the gate,
+        # baselined ones carry the entry's why
+        entries = ([] if args.no_baseline
+                   else load_baseline(args.baseline))
+        if args.rules:
+            entries = [e for e in entries
+                       if e["rule"] in set(args.rules)]
+        baselined = []
+        for f in all_findings:
+            hit = match_entry(entries, f)
+            if hit is not None:
+                baselined.append(dict(f.to_dict(),
+                                      baseline_why=hit["why"]))
         print(json.dumps({
             "findings": [f.to_dict() for f in fresh],
+            "baselined_findings": baselined,
             "stale_baseline_entries": stale,
             "total_findings_including_baselined": len(all_findings),
+            "exit_code": exit_code,
         }, indent=1))
     else:
         for f in fresh:
@@ -141,7 +169,7 @@ def main(argv=None) -> int:
             [f for f in fresh if f.rule != "unjustified-baseline"])
         print(f"graftlint: {len(fresh)} finding(s), {n_base} baselined, "
               f"{len(stale)} stale baseline entr(ies)")
-    return 1 if (fresh or stale) else 0
+    return exit_code
 
 
 if __name__ == "__main__":

@@ -83,6 +83,24 @@ def format_report(summary: dict, path: str) -> str:
         for flag in ("lockwatch_cycles", "lockwatch_watchdog_dumps"):
             if watch.get(flag):
                 lines.append(f"!! {flag}: {watch[flag]:.0f}")
+    # network telemetry (ISSUE 18): one row per watched endpoint when the
+    # run carried utils.netwatch metrics; silent otherwise
+    net = summary.get("netwatch")
+    if net:
+        lines += ["", "netwatch (per watched endpoint)",
+                  f"{'endpoint':<28} {'ops':>7} {'timeouts':>8} "
+                  f"{'reconnects':>10} {'retries':>7} {'wait max ms':>11}"]
+        eps = sorted({k[len("netwatch_"):-len("_ops")]
+                      for k in net if k.endswith("_ops")})
+        for ep in eps:
+            get = lambda stat: net.get(f"netwatch_{ep}_{stat}", 0)  # noqa: E731
+            lines.append(
+                f"{ep:<28} {get('ops'):>7.0f} {get('timeouts'):>8.0f} "
+                f"{get('reconnects'):>10.0f} {get('retries'):>7.0f} "
+                f"{get('wait_ms_max'):>11.3f}")
+        if net.get("netwatch_stall_dumps"):
+            lines.append(
+                f"!! netwatch_stall_dumps: {net['netwatch_stall_dumps']:.0f}")
     # serve / federation registry metrics (ISSUE 12): one row per metric
     # when the run carried serve_* / federation_* keys
     # (registry.flat_record via the subsystem metrics_record()s); silent
