@@ -2357,6 +2357,215 @@ def measure_serve() -> float:
     return report.tokens_per_sec
 
 
+def measure_fleet() -> float:
+    """ISSUE 19 fleet bench: the multi-replica router (serve/router.py)
+    over real TCP-tracker membership, two in-process replicas each
+    running the full FleetReplica serve/heartbeat loops.
+
+    Two phases:
+
+    - healthy: the serve-stage open-loop traffic routed through the
+      fleet with session keys (affinity exercised), measured exactly
+      like ``serve`` so the ``latency``/``goodput`` detail blocks land
+      as fleet_latency_* / fleet_goodput_rps rows in bench_report.
+    - chaos: a second batch of longer requests, one replica ``die()``d
+      mid-stream (no deregistration — the router must detect it off
+      heartbeat staleness), a replacement cold-started from live params
+      through the burial callback. Every accepted request must complete
+      token-identical to a single-engine oracle; the ``requeue`` block
+      carries requeue_to_first_token_ms — the recovery-latency number
+      this PR's LOWER-IS-BETTER row tracks (how long a client stream
+      stalls across a replica death).
+
+    Headline value = healthy-phase generated-tokens/sec through the
+    router (fleet_tokens_per_sec)."""
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.models.transformer_lm import init_lm_params
+    from deeplearning4j_tpu.scaleout.remote_tracker import (
+        StateTrackerClient,
+        StateTrackerServer,
+    )
+    from deeplearning4j_tpu.serve import (
+        DecodeEngine,
+        FleetReplica,
+        FleetRouter,
+        run_open_loop,
+    )
+
+    if _fast():
+        vocab, d, heads, experts, dff, layers = 128, 32, 2, 2, 64, 2
+        slots, max_len, max_new, n_req, rate = 4, 64, 8, 12, 200.0
+        prompt_lo, prompt_hi = 4, 12
+        slo_ms = 50.0
+        chaos_n, chaos_new = 8, 16
+    else:
+        vocab, d, heads, experts, dff, layers = LMC_VOCAB, 256, 4, 4, 512, 2
+        slots, max_len, max_new, n_req, rate = 8, 256, 32, 24, 50.0
+        prompt_lo, prompt_hi = 16, 48
+        slo_ms = 250.0
+        chaos_n, chaos_new = 12, 32
+
+    params = init_lm_params(jax.random.PRNGKey(0), vocab, d, heads, experts,
+                            dff, n_layers=layers)
+    rng = np.random.RandomState(7)
+    prompts = [list(rng.randint(0, vocab,
+                                rng.randint(prompt_lo, prompt_hi)))
+               for _ in range(n_req)]
+    chaos_prompts = [list(rng.randint(0, vocab,
+                                      rng.randint(prompt_lo, prompt_hi)))
+                     for _ in range(chaos_n)]
+    engine_kw = dict(n_slots=slots, max_len=max_len, serve_dtype="bf16")
+
+    def warm(eng):
+        for b in sorted({eng.bucket_for(len(p))
+                         for p in prompts + chaos_prompts}):
+            eng.generate([1] * min(b, max_len - 1), max_new_tokens=2)
+
+    # the single-engine oracle the chaos phase's outputs are pinned to
+    oracle = DecodeEngine(params, heads, **engine_kw)
+    warm(oracle)
+    expected = [oracle.generate(p, max_new_tokens=chaos_new)
+                for p in chaos_prompts]
+
+    with StateTrackerServer() as tsrv:
+        replicas = []
+        for rid in ("r1", "r2"):
+            eng = DecodeEngine(params, heads, **engine_kw)
+            warm(eng)
+            rep = FleetReplica(eng, tsrv.address, rid,
+                               heartbeat_s=0.05, poll_s=0.005,
+                               publish_s=0.1)
+            rep.start()
+            replicas.append(rep)
+
+        spawned = []
+
+        def cold_start(_failed_rid):
+            # device-to-device replacement: adopt the live tree through
+            # the redistribution plans, rejoin the same membership
+            rep = FleetReplica.from_live_params(
+                params, heads, tsrv.address, "r3",
+                engine_kwargs=engine_kw,
+                heartbeat_s=0.05, poll_s=0.005, publish_s=0.1)
+            rep.start()
+            spawned.append(rep)
+
+        rtracker = StateTrackerClient(tsrv.address)
+        router = FleetRouter(rtracker, stale_after_s=0.3, dead_after_s=0.8,
+                             poll_s=0.005, cold_start=cold_start)
+        # let both replicas publish a first heartbeat + load row so the
+        # healthy phase starts with full membership
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            router.step()
+            if router.fleet_snapshot()["alive"] >= 2:
+                break
+            time.sleep(0.02)
+
+        # ---- healthy phase: open-loop through the router, with
+        # session keys so affinity is on the measured path ----
+        sessions = [f"s{i % 4}" for i in range(n_req)]
+        report = run_open_loop(router, prompts, rate_rps=rate,
+                               max_new_tokens=max_new, slo_ms=slo_ms,
+                               sessions=sessions)
+        healthy_snap = router.fleet_snapshot()
+
+        # ---- chaos phase: kill r1 once it is mid-stream on at least
+        # one request, let the burial requeue + cold-start machinery
+        # finish every request anyway ----
+        tok0 = replicas[0].engine.stats()["tokens_total"]
+        reqs = [router.submit(p, max_new_tokens=chaos_new,
+                              session=f"c{i % 3}")
+                for i, p in enumerate(chaos_prompts)]
+        t_kill = None
+        deadline = time.monotonic() + 120.0
+        while router.has_work():
+            if time.monotonic() > deadline:
+                raise TimeoutError("fleet chaos phase did not drain")
+            router.step()
+            if t_kill is None:
+                # kill off the victim's OWN counters, not the router's
+                # sweep-sampled view: fires the instant r1 has generated
+                # >= 2 chaos tokens while still holding active work, so
+                # the death is mid-stream even when a whole request
+                # drains between two router sweeps
+                st = replicas[0].engine.stats()
+                if st["tokens_total"] >= tok0 + 2 and (
+                        st["active_slots"] > 0 or st["queue_depth"] > 0):
+                    replicas[0].die()
+                    t_kill = time.monotonic()
+        snap = router.fleet_snapshot()
+
+        requeued = [r for r in reqs if r.requeues > 0]
+        gaps_ms = [(r.t_first_after_requeue - r.t_requeue) * 1000.0
+                   for r in requeued
+                   if r.t_requeue is not None
+                   and r.t_first_after_requeue is not None]
+        token_identical = all(r.generated == exp
+                              for r, exp in zip(reqs, expected))
+
+        for rep in replicas + spawned:
+            rep.stop()
+        rtracker.close()
+
+    detail = {
+        "replicas": 2, "slots": slots, "max_len": max_len,
+        "n_requests": n_req, "max_new_tokens": max_new,
+        "offered_rps": rate, "serve_dtype": "bf16",
+        "tokens_per_sec": round(report.tokens_per_sec, 1),
+        "completed": report.completed,
+        "latency": {
+            "p50_ms": round(report.latency_p50_ms, 2),
+            "p95_ms": round(report.latency_p95_ms, 2),
+            "p99_ms": round(report.latency_p99_ms, 2),
+            "mean_ms": round(report.latency_mean_ms, 2),
+            "first_token_p50_ms": (
+                round(report.first_token_p50_ms, 2)
+                if report.first_token_p50_ms is not None else None),
+            "first_token_p99_ms": (
+                round(report.first_token_p99_ms, 2)
+                if report.first_token_p99_ms is not None else None),
+        },
+        "goodput": {
+            "slo_ms": slo_ms,
+            "goodput_rps": round(report.goodput_rps, 3),
+            "slo_attainment": round(report.slo_attainment, 4),
+        },
+        "healthy": {
+            "alive": healthy_snap["alive"],
+            "dispatches": {r["replica_id"]: r["dispatches"]
+                           for r in healthy_snap["replicas"]},
+            "affinity_sessions": len(healthy_snap["affinity"]),
+        },
+        "chaos": {
+            "n_requests": chaos_n, "max_new_tokens": chaos_new,
+            "killed_replica": "r1",
+            "kill_fired": t_kill is not None,
+            "completed": sum(1 for r in reqs if r.t_done is not None),
+            "requeued_requests": len(requeued),
+            "token_identical": token_identical,
+            "failed_replicas": snap["failed_replicas"],
+            "alive_after": snap["alive"],
+            "replacement_joined": any(
+                r["replica_id"] == "r3" and r["state"] == "alive"
+                for r in snap["replicas"]),
+        },
+        # the recovery number: how long a requeued client stream waits
+        # between its replica dying and its first post-requeue token
+        "requeue": {
+            "requeued_requests": len(gaps_ms),
+            "requeue_to_first_token_ms": (
+                round(float(np.mean(gaps_ms)), 2) if gaps_ms else None),
+            "requeue_to_first_token_max_ms": (
+                round(max(gaps_ms), 2) if gaps_ms else None),
+        },
+    }
+    print("STAGE_DETAIL " + json.dumps(detail), flush=True)
+    return report.tokens_per_sec
+
+
 def measure_observability() -> float:
     """ISSUE 15 watchtower bench: the SAME open-loop decode-engine run
     twice — unarmed vs with the full watch layer armed (a MetricsHistory
@@ -2766,6 +2975,8 @@ def run_stage(name: str) -> float:
         return measure_ref_micro()
     if name == "serve":
         return measure_serve()
+    if name == "fleet":
+        return measure_fleet()
     if name == "observability":
         return measure_observability()
     if name == "runprof":
@@ -2875,6 +3086,7 @@ STAGES = [
     ("moe", 220),
     ("comm_overlap", 240),
     ("serve", 300),
+    ("fleet", 300),
     ("observability", 240),
     ("runprof", 260),
     ("cpu_word2vec", 150),
@@ -2958,7 +3170,7 @@ def main() -> None:
             # bench_report; the sharded blob's absolute peak rides the
             # LOWER-IS-BETTER optimizer_profile_peak_bytes row)
             key = f"{stage}_peak_bytes_ratio"
-        elif stage in ("moe", "serve"):
+        elif stage in ("moe", "serve", "fleet"):
             key = f"{stage}_tokens_per_sec"
         elif stage == "comm_overlap":
             # strict/overlapped pp step-time ratio (>1 = overlap faster)

@@ -233,6 +233,12 @@ def predict(args) -> int:
     return 0
 
 
+def _fleet(args) -> int:
+    from deeplearning4j_tpu.serve.fleet import replica_main
+
+    return replica_main(args.fleet_args)
+
+
 def _add_common(p: argparse.ArgumentParser, needs_model_in: bool,
                 conf_required: bool = True) -> None:
     p.add_argument("--conf", required=conf_required,
@@ -301,6 +307,18 @@ def build_parser() -> argparse.ArgumentParser:
     lm.add_argument("--eos-id", type=int, default=None)
     lm.add_argument("--seed", type=int, default=0)
     p_pred.set_defaults(func=predict)
+
+    # ISSUE 19: the serving-fleet replica process, also reachable as
+    # ``python -m deeplearning4j_tpu.serve.fleet``. Arguments pass
+    # through verbatim to serve.fleet.replica_main (its parser owns the
+    # --replica/--tracker/--synthetic surface).
+    p_fleet = sub.add_parser(
+        "fleet",
+        help="run a serving-fleet replica (args forwarded to "
+             "deeplearning4j_tpu.serve.fleet, e.g. fleet --replica "
+             "--tracker HOST:PORT --synthetic V,D,H,E,DFF,L)")
+    p_fleet.add_argument("fleet_args", nargs=argparse.REMAINDER)
+    p_fleet.set_defaults(func=_fleet)
     return parser
 
 

@@ -4,6 +4,7 @@ overview; decode-mode model math lives in models/transformer_lm.py and the
 serving-precision seam in serve/quant.py."""
 
 from deeplearning4j_tpu.serve.engine import DecodeEngine, ServeRequest
+from deeplearning4j_tpu.serve.fleet import FleetReplica, replica_main
 from deeplearning4j_tpu.serve.loadgen import (
     LoadReport,
     arrival_schedule,
@@ -11,6 +12,11 @@ from deeplearning4j_tpu.serve.loadgen import (
     run_open_loop_http,
 )
 from deeplearning4j_tpu.serve.prefix_cache import PrefixPageCache
+from deeplearning4j_tpu.serve.router import (
+    FleetRequest,
+    FleetRouter,
+    pick_replica,
+)
 from deeplearning4j_tpu.serve.quant import (
     QuantTensor,
     dequantize_tree,
@@ -26,6 +32,11 @@ from deeplearning4j_tpu.serve.speculative import (
 __all__ = [
     "DecodeEngine",
     "ServeRequest",
+    "FleetReplica",
+    "FleetRequest",
+    "FleetRouter",
+    "pick_replica",
+    "replica_main",
     "LoadReport",
     "arrival_schedule",
     "run_open_loop",

@@ -557,6 +557,17 @@ def default_rules() -> List[AlertRule]:
     - ``input_wait_high`` — runtime profiler (ISSUE 17): the
       input-wait hook reports the step spending >30% of its cycle
       starved for host data — the ROADMAP 5 starvation signal.
+    - ``fleet_replica_down`` — serving fleet (ISSUE 19): a fleet
+      replica's ``fleet_replica_heartbeat_unix{replica=…}`` timestamp
+      lapsed on the router — the replica died or wedged. Buried
+      replicas retire their series to the -1.0 sentinel (the death was
+      handled: in-flight work requeued, cold start dispatched), so
+      only UNHANDLED staleness pages.
+    - ``fleet_queue_imbalance`` — serving fleet (ISSUE 19): the
+      max/mean replica queue-depth ratio the router publishes shows
+      one replica hoarding load — session affinity gone pathological
+      or a replica decoding far below fleet speed. Gauge is born on
+      the first membership sweep with a nonzero mean depth.
     """
     return [
         AlertRule(
@@ -647,6 +658,20 @@ def default_rules() -> List[AlertRule]:
             description="steps spend >30% of their cycle waiting on "
                         "host input — the data pipeline is starving "
                         "the device"),
+        AlertRule(
+            name="fleet_replica_down", kind="absence",
+            metric="fleet_replica_heartbeat_unix", stale_s=5.0,
+            for_s=0.0, severity="critical",
+            description="a fleet replica's heartbeat stopped advancing "
+                        "and the router has not yet buried it — "
+                        "requests routed there are stalling"),
+        AlertRule(
+            name="fleet_queue_imbalance", kind="threshold",
+            metric="fleet_queue_imbalance_ratio", threshold=3.0,
+            op=">", for_s=10.0, severity="warning",
+            description="max/mean fleet replica queue depth above 3x "
+                        "sustained — routing is piling work onto one "
+                        "replica"),
     ]
 
 

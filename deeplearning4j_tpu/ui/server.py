@@ -39,7 +39,8 @@ INDEX_HTML = """<!doctype html>
 <h2>serving</h2>
 <ul>
 <li><a href="/api/serve">decode-engine stats (queue, slots, in-flight request ages)</a></li>
-<li>POST /api/generate {"prompt": [ids], "max_new_tokens": N, "temperature": T} (traceparent honoured)</li>
+<li><a href="/api/fleet">serving-fleet view (per-replica health/load, session affinity)</a></li>
+<li>POST /api/generate {"prompt": [ids], "max_new_tokens": N, "temperature": T, "session": S} (traceparent honoured; routed through the fleet when attached)</li>
 </ul>
 <h2>cluster</h2>
 <ul>
@@ -84,6 +85,7 @@ class UiServer:
         self._tracer = None
         self._profile_store = None
         self._engine = None
+        self._fleet = None
         self._federation = None
         self._history = None
         self._alerts = None
@@ -128,6 +130,19 @@ class UiServer:
         (``engine.start()``) for concurrent requests; without it each
         handler drives the scheduler inline."""
         self._engine = engine
+        self._generate_timeout_s = float(generate_timeout_s)
+
+    # ---- serving fleet (ISSUE 19: the router behind /api/generate) ----
+    def attach_fleet(self, router, generate_timeout_s: float = 120.0
+                     ) -> None:
+        """Serve a serve.FleetRouter: POST ``/api/generate`` dispatches
+        through the fleet (an optional ``"session"`` string in the
+        payload pins the request to its affinity replica) instead of a
+        locally attached engine, and GET ``/api/fleet`` snapshots the
+        per-replica health/load/affinity tables. Start the router's
+        background loop (``router.start()``) so handler threads only
+        block on their own request."""
+        self._fleet = router
         self._generate_timeout_s = float(generate_timeout_s)
 
     # ---- watchtower (ISSUE 15: history + alert verdicts on the UI port) ----
@@ -387,6 +402,12 @@ class UiServer:
                                    404)
                         return
                     self._json(ui._engine.stats())
+                elif url.path == "/api/fleet":
+                    if ui._fleet is None:
+                        self._json({"error": "no fleet router attached"},
+                                   404)
+                        return
+                    self._json(ui._fleet.fleet_snapshot())
                 elif url.path == "/api/words":
                     self._json({"count": len(ui._words), "words": ui._words[:200]})
                 elif url.path == "/api/nearest":
@@ -476,7 +497,7 @@ class UiServer:
                 if url.path != "/api/generate":
                     self._json({"error": "not found"}, 404)
                     return
-                if ui._engine is None:
+                if ui._engine is None and ui._fleet is None:
                     self._json({"error": "no decode engine attached"}, 404)
                     return
                 payload = self._read_json_body()
@@ -500,6 +521,10 @@ class UiServer:
                     self._json({"error": "max_new_tokens/temperature must "
                                 "be numbers"}, 400)
                     return
+                session = payload.get("session")
+                if session is not None and not isinstance(session, str):
+                    self._json({"error": "session must be a string"}, 400)
+                    return
                 # ISSUE 12: W3C trace-context propagation — an inbound
                 # ``traceparent`` parents this handler's span (and the
                 # engine's serve.request tree under it) beneath the
@@ -518,10 +543,19 @@ class UiServer:
                             attrs={"path": url.path,
                                    "prompt_len": len(prompt),
                                    "remote_trace": ctx is not None}) as sp:
-                        tokens = ui._engine.generate(
-                            prompt, max_new_tokens=max_new,
-                            temperature=temperature,
-                            timeout=ui._generate_timeout_s)
+                        # ISSUE 19: the fleet front end wins when
+                        # attached — the local engine stays as the
+                        # single-process fallback
+                        if ui._fleet is not None:
+                            tokens = ui._fleet.generate(
+                                prompt, max_new_tokens=max_new,
+                                temperature=temperature, session=session,
+                                timeout=ui._generate_timeout_s)
+                        else:
+                            tokens = ui._engine.generate(
+                                prompt, max_new_tokens=max_new,
+                                temperature=temperature,
+                                timeout=ui._generate_timeout_s)
                 except ValueError as exc:  # engine-side validation
                     self._json({"error": str(exc)}, 400)
                     return

@@ -100,6 +100,14 @@ def _heartbeat_history(age_s):
     return h, T0
 
 
+def _fleet_heartbeat_history(age_s):
+    h, reg = _hist()
+    reg.gauge("fleet_replica_heartbeat_unix",
+              {"replica": "r1"}).set(T0 - age_s)
+    h.sample_once(now=T0)
+    return h, T0
+
+
 def _climbing_gauge(name, slope_per_s, until_s=60.0, dt=5.0):
     """History with a gauge climbing ``slope_per_s`` from T0 to
     T0+until_s, sampled every ``dt``; now = T0+10. Samples extend PAST
@@ -184,6 +192,20 @@ RULE_FIXTURES = {
         lambda: _two_sample_gauge("runprof_input_wait_fraction",
                                   0.05, 0.05),
     ),
+    # ISSUE 19 fleet rules: a replica heartbeat gauge 30s stale fires
+    # the absence rule (1s fresh stays quiet); a router-published
+    # max/mean queue-depth ratio of 8 fires imbalance (balanced ~1 is
+    # quiet).
+    "fleet_replica_down": (
+        lambda: _fleet_heartbeat_history(30.0),
+        lambda: _fleet_heartbeat_history(1.0),
+    ),
+    "fleet_queue_imbalance": (
+        lambda: _two_sample_gauge("fleet_queue_imbalance_ratio",
+                                  8.0, 8.0),
+        lambda: _two_sample_gauge("fleet_queue_imbalance_ratio",
+                                  1.0, 1.0),
+    ),
 }
 
 
@@ -232,6 +254,19 @@ class TestDefaultRulePack:
         h.sample_once(now=T0)
         rule = [r for r in default_rules()
                 if r.name == "worker_heartbeat_stale"][0]
+        assert _drive(rule, h, T0) == "inactive"
+
+    def test_buried_fleet_replica_sentinel_not_stale(self):
+        """Same sentinel discipline for the fleet: burying a replica
+        retires its heartbeat series to -1.0 (death handled — work
+        requeued, cold start dispatched), so fleet_replica_down stops
+        firing."""
+        h, reg = _hist()
+        reg.gauge("fleet_replica_heartbeat_unix",
+                  {"replica": "r1"}).set(-1.0)
+        h.sample_once(now=T0)
+        rule = [r for r in default_rules()
+                if r.name == "fleet_replica_down"][0]
         assert _drive(rule, h, T0) == "inactive"
 
     def test_no_data_never_fires(self):

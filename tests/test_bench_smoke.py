@@ -469,6 +469,63 @@ def test_bench_serve_stage_on_cpu():
     assert sd["netwatch"]["overhead_pct"] < 5.0, sd["netwatch"]
 
 
+def test_bench_fleet_stage_on_cpu():
+    """ISSUE 19 acceptance: the fleet stage runs end to end on the CPU
+    backend — two real FleetReplica serve/heartbeat loops over the TCP
+    tracker, open-loop traffic routed with session affinity (latency +
+    goodput blocks land for the fleet_* bench_report rows), then a
+    mid-stream replica kill: the router detects the death off heartbeat
+    staleness, requeues every in-flight request, cold-starts a
+    replacement from live params, and every accepted request completes
+    token-identical to the single-engine oracle. The requeue block
+    carries the recovery-latency number the LOWER-IS-BETTER
+    fleet_requeue_to_first_token_ms row tracks."""
+    env = dict(os.environ)
+    env["BENCH_FORCE_CPU"] = "1"
+    env["BENCH_FAST"] = "1"
+    env["BENCH_BUDGET_SEC"] = "300"
+    env["BENCH_ONLY"] = "fleet"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=360, cwd=REPO, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    det = json.loads(out.stdout.strip().splitlines()[-1])["detail"]
+    assert det.get("fleet_tokens_per_sec"), det.get("fleet_status")
+    sd = det["fleet_detail"]
+    assert det["fleet_tokens_per_sec"] == sd["tokens_per_sec"]
+    # healthy phase: full membership, every request completed, latency/
+    # goodput coherent (these blocks feed the bench_report extractors)
+    assert sd["completed"] == sd["n_requests"]
+    assert sd["replicas"] == 2
+    lat = sd["latency"]
+    assert lat["p99_ms"] >= lat["p95_ms"] >= lat["p50_ms"] > 0
+    assert lat["first_token_p99_ms"] >= lat["first_token_p50_ms"] > 0
+    gp = sd["goodput"]
+    assert gp["slo_ms"] > 0
+    assert 0.0 <= gp["slo_attainment"] <= 1.0
+    assert gp["goodput_rps"] >= 0.0
+    healthy = sd["healthy"]
+    assert healthy["alive"] == 2
+    assert healthy["affinity_sessions"] >= 1
+    assert sum(healthy["dispatches"].values()) >= sd["n_requests"]
+    # chaos phase: the kill really fired mid-stream, every accepted
+    # request still completed, outputs pinned to the oracle, the dead
+    # replica buried and its replacement alive in the final snapshot
+    chaos = sd["chaos"]
+    assert chaos["kill_fired"] is True
+    assert chaos["completed"] == chaos["n_requests"]
+    assert chaos["token_identical"] is True
+    assert chaos["failed_replicas"] == ["r1"]
+    assert chaos["replacement_joined"] is True
+    assert chaos["requeued_requests"] >= 1
+    rq = sd["requeue"]
+    assert rq["requeued_requests"] >= 1
+    assert rq["requeue_to_first_token_ms"] > 0
+    assert rq["requeue_to_first_token_max_ms"] >= \
+        rq["requeue_to_first_token_ms"]
+
+
 def test_bench_observability_stage_on_cpu():
     """ISSUE 15 acceptance: the observability stage runs end to end on
     the CPU backend — the SAME open-loop serve run with the watch layer
@@ -505,8 +562,8 @@ def test_bench_observability_stage_on_cpu():
     assert hist["series"] > 0
     assert hist["serve_tokens_rate_per_s"] > 0   # live rate query worked
     al = sd["alerts"]
-    assert al["rules"] == 13  # default pack incl. ISSUE 16 serve rules
-    # + the ISSUE 17 runprof rules
+    assert al["rules"] == 15  # default pack incl. ISSUE 16 serve rules
+    # + the ISSUE 17 runprof rules + the ISSUE 19 fleet rules
     # a healthy run pages nobody
     assert al["quiet_run_firing"] == []
     # the injected-fault demo fired BOTH demo rules deterministically...

@@ -1,0 +1,539 @@
+"""Serving fleet (ISSUE 19): the multi-replica decode front end.
+
+Layers under test, bottom-up:
+
+- ``pick_replica`` routing policy in isolation — deterministic
+  least-loaded tie-break, session affinity, stale exclusion.
+- ``FleetRouter`` over a scripted tracker (no engines): stale replicas
+  get zero new dispatches and recover without burial; affinity survives
+  a stale/rejoin cycle; a death requeues with the carried tokens and a
+  decremented budget, and the buried attempt's late rows are inert.
+- In-process fleet end-to-end (real ``FleetReplica`` serve loops over
+  ``InMemoryStateTracker``): routed greedy output token-identical to the
+  single-engine oracle, affinity pinned, UiServer ``/api/generate`` +
+  ``/api/fleet`` surface, thread-count hygiene under start/stop cycles.
+- The chaos pin: two SUBPROCESS replicas over the real TCP tracker,
+  ``kill -9`` one mid-stream under open-loop submission — every accepted
+  request completes token-identical to the oracle through requeue, the
+  ``fleet_replica_down`` absence rule fires and resolves (the burial
+  sentinel retires the series), and the cold-started replacement
+  rejoins the membership.
+"""
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.transformer_lm import init_lm_params
+from deeplearning4j_tpu.scaleout.remote_tracker import (
+    StateTrackerClient,
+    StateTrackerServer,
+)
+from deeplearning4j_tpu.scaleout.statetracker import InMemoryStateTracker
+from deeplearning4j_tpu.serve import (
+    DecodeEngine,
+    FleetReplica,
+    FleetRouter,
+    pick_replica,
+)
+from deeplearning4j_tpu.serve.router import (
+    HB_PREFIX,
+    LOAD_PREFIX,
+    PROG_PREFIX,
+    REQ_PREFIX,
+)
+from deeplearning4j_tpu.telemetry.registry import MetricsRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+V, D, H, E, DFF, L = 61, 16, 2, 4, 32, 2
+MAXLEN = 32
+SYNTH = f"{V},{D},{H},{E},{DFF},{L}"
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_lm_params(jax.random.PRNGKey(0), V, D, H, E, DFF,
+                          n_layers=L)
+
+
+def _prompts(n, seed=1, lo=3, hi=9):
+    rng = np.random.RandomState(seed)
+    return [list(map(int, rng.randint(0, V, rng.randint(lo, hi))))
+            for _ in range(n)]
+
+
+def _engine(params, **kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("max_len", MAXLEN)
+    kw.setdefault("serve_dtype", None)  # exact fp32: oracle parity
+    return DecodeEngine(params, H, **kw)
+
+
+# ------------------------------------------ pick_replica policy (pure) ----
+
+def _view(rid, state="alive", outstanding=0, queue_depth=0,
+          active_slots=0):
+    return {"replica_id": rid, "state": state, "outstanding": outstanding,
+            "queue_depth": queue_depth, "active_slots": active_slots}
+
+
+class TestPickReplica:
+    def test_least_loaded_wins(self):
+        views = [_view("r1", outstanding=3), _view("r2", queue_depth=1)]
+        assert pick_replica(views) == "r2"
+
+    def test_load_sums_outstanding_queue_and_slots(self):
+        # 1+1+1 on r1 vs a bare queue_depth=2 on r2: r2 is lighter
+        views = [_view("r1", outstanding=1, queue_depth=1, active_slots=1),
+                 _view("r2", queue_depth=2)]
+        assert pick_replica(views) == "r2"
+
+    def test_tie_break_is_deterministic_and_order_independent(self):
+        a = [_view("r2"), _view("r1"), _view("r3")]
+        b = [_view("r3"), _view("r2"), _view("r1")]
+        # equal loads: lexicographically smallest id, however the views
+        # are ordered, on every call — equal fleets route identically
+        for _ in range(5):
+            assert pick_replica(a) == "r1"
+            assert pick_replica(b) == "r1"
+
+    def test_stale_and_dead_excluded_even_at_zero_load(self):
+        views = [_view("r1", state="stale"),
+                 _view("r2", state="dead"),
+                 _view("r3", outstanding=10)]
+        assert pick_replica(views) == "r3"
+
+    def test_nothing_alive_returns_none(self):
+        assert pick_replica([]) is None
+        assert pick_replica([_view("r1", state="stale")]) is None
+
+    def test_pinned_live_session_beats_load(self):
+        views = [_view("r1", outstanding=10), _view("r2")]
+        assert pick_replica(views, session="s",
+                            affinity={"s": "r1"}) == "r1"
+
+    def test_pin_to_non_alive_replica_falls_back_to_least_loaded(self):
+        views = [_view("r1", state="stale"), _view("r2", outstanding=1),
+                 _view("r3")]
+        assert pick_replica(views, session="s",
+                            affinity={"s": "r1"}) == "r3"
+
+
+# ----------------------------- router over a scripted tracker (no engines) ----
+
+class _Scripted:
+    """Drives the tracker exactly like a FleetReplica would, but under
+    test control: heartbeats only when told, dispatch rows claimed and
+    progress rows emitted on demand — so membership transitions and
+    requeue semantics are deterministic, no real engine timing."""
+
+    def __init__(self, tracker, rid):
+        self.tracker = tracker
+        self.rid = rid
+
+    def register(self):
+        self.tracker.add_worker(self.rid)
+        self.beat()
+        self.publish_load()
+
+    def beat(self):
+        self.tracker.increment(HB_PREFIX + self.rid, 1.0)
+
+    def publish_load(self, queue_depth=0, active_slots=0, slots=2):
+        self.tracker.put_kv(LOAD_PREFIX + self.rid, json.dumps({
+            "replica_id": self.rid, "queue_depth": queue_depth,
+            "active_slots": active_slots, "slots": slots,
+            "weight_version": "scripted"}))
+
+    def claim(self):
+        """{request_rid: latest dispatch spec} addressed to this replica."""
+        rows = self.tracker.kv_snapshot(f"{REQ_PREFIX}{self.rid}.")
+        out = {}
+        for key in sorted(rows):
+            spec = json.loads(rows[key])
+            out[spec["rid"]] = spec
+        return out
+
+    def emit(self, req_rid, attempt, tokens, done=False,
+             finish_reason=None):
+        self.tracker.put_kv(PROG_PREFIX + req_rid, json.dumps({
+            "attempt": attempt, "tokens": list(tokens), "done": done,
+            "finish_reason": finish_reason, "replica": self.rid}))
+
+
+def _router(tracker, **kw):
+    kw.setdefault("registry", MetricsRegistry())
+    kw.setdefault("poll_s", 0.001)
+    return FleetRouter(tracker, **kw)
+
+
+def _step_until(router, cond, beat=(), timeout_s=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout_s
+    while not cond():
+        assert time.monotonic() < deadline, f"timed out waiting: {msg}"
+        for rep in beat:
+            rep.beat()
+        router.step()
+
+
+def _state(router, rid):
+    rows = {r["replica_id"]: r
+            for r in router.fleet_snapshot()["replicas"]}
+    return rows.get(rid, {}).get("state")
+
+
+class TestScriptedMembership:
+    def test_stale_replica_gets_zero_dispatches_then_recovers(self):
+        tracker = InMemoryStateTracker()
+        r1, r2 = _Scripted(tracker, "r1"), _Scripted(tracker, "r2")
+        r1.register()
+        r2.register()
+        router = _router(tracker, stale_after_s=0.08, dead_after_s=30.0)
+        router.step()
+        assert _state(router, "r1") == "alive"
+        # r1 falls silent; r2 keeps beating → r1 stale, NOT buried
+        _step_until(router, lambda: _state(router, "r1") == "stale",
+                    beat=(r2,), msg="r1 stale")
+        assert router.fleet_snapshot()["failed_replicas"] == []
+        for _ in range(4):
+            router.submit([1, 2, 3])
+        router.step()
+        snap = {r["replica_id"]: r
+                for r in router.fleet_snapshot()["replicas"]}
+        assert snap["r2"]["dispatches"] == 4
+        assert snap["r1"]["dispatches"] == 0
+        # recovery without burial: one fresh heartbeat → alive again
+        r1.beat()
+        _step_until(router, lambda: _state(router, "r1") == "alive",
+                    beat=(r2,), msg="r1 recovered")
+        assert router.fleet_snapshot()["failed_replicas"] == []
+
+    def test_affinity_survives_stale_rejoin(self):
+        tracker = InMemoryStateTracker()
+        r1, r2 = _Scripted(tracker, "r1"), _Scripted(tracker, "r2")
+        r1.register()
+        r2.register()
+        router = _router(tracker, stale_after_s=0.08, dead_after_s=30.0)
+        router.step()
+        req = router.submit([1, 2, 3], max_new_tokens=2, session="s")
+        router.step()
+        assert req.replica == "r1"  # tie-break
+        assert router.fleet_snapshot()["affinity"] == {"s": "r1"}
+        r1.emit(req.rid, 1, [4, 5], done=True)
+        _step_until(router, lambda: req.t_done is not None,
+                    beat=(r1, r2), msg="req done")
+        # r1 goes stale, then rejoins — the pin must survive the cycle
+        _step_until(router, lambda: _state(router, "r1") == "stale",
+                    beat=(r2,), msg="r1 stale")
+        r1.beat()
+        _step_until(router, lambda: _state(router, "r1") == "alive",
+                    beat=(r2,), msg="r1 rejoined")
+        assert router.fleet_snapshot()["affinity"] == {"s": "r1"}
+        # r1 is now the HEAVIER choice; the pin must still win
+        r1.publish_load(queue_depth=5)
+        req2 = router.submit([1, 2, 3], session="s")
+        router.step()
+        assert req2.replica == "r1"
+        # while a fresh session routes by load, to r2
+        req3 = router.submit([1, 2, 3], session="t")
+        router.step()
+        assert req3.replica == "r2"
+
+    def test_death_requeues_carried_tokens_and_decrements_budget(self):
+        tracker = InMemoryStateTracker()
+        r1, r2 = _Scripted(tracker, "r1"), _Scripted(tracker, "r2")
+        r1.register()
+        r2.register()
+        router = _router(tracker, stale_after_s=0.05, dead_after_s=0.12)
+        router.step()
+        prompt = [1, 2, 3, 4]
+        req = router.submit(prompt, max_new_tokens=8, session="s")
+        router.step()
+        assert req.replica == "r1"
+        spec = r1.claim()[req.rid]
+        assert spec["attempt"] == 1
+        assert spec["prompt"] == prompt
+        assert spec["max_new"] == 8
+        # r1 streams 3 tokens, then dies (heartbeats stop)
+        r1.emit(req.rid, 1, [11, 12, 13])
+        _step_until(router, lambda: req.generated == [11, 12, 13],
+                    beat=(r1, r2), msg="partial progress")
+        _step_until(router,
+                    lambda: "r1" in router.fleet_snapshot()[
+                        "failed_replicas"],
+                    beat=(r2,), msg="r1 buried")
+        assert req.requeues == 1
+        assert req.t_requeue is not None
+        # the pin died with the replica: the session re-pins at redispatch
+        router.step()
+        spec2 = r2.claim()[req.rid]
+        assert spec2["attempt"] == 2
+        assert spec2["prompt"] == prompt + [11, 12, 13]  # retained stream
+        assert spec2["max_new"] == 5                     # budget shrunk
+        assert router.fleet_snapshot()["affinity"] == {"s": "r2"}
+        # a late zombie row from the buried attempt must be inert
+        r1.emit(req.rid, 1, [11, 12, 13, 99, 98], done=True)
+        router.step()
+        assert req.t_done is None
+        assert req.generated == [11, 12, 13]
+        # the replacement attempt publishes ONLY its continuation
+        r2.emit(req.rid, 2, [14, 15, 16, 17, 18], done=True)
+        _step_until(router, lambda: req.t_done is not None,
+                    beat=(r2,), msg="continuation done")
+        assert req.generated == [11, 12, 13, 14, 15, 16, 17, 18]
+        assert req.t_first_after_requeue is not None
+        assert req.t_first_after_requeue >= req.t_requeue
+        snap = router.fleet_snapshot()
+        assert snap["requeued_total"] == 1
+        assert snap["completed_total"] == 1
+
+
+# ------------------------------ in-process fleet (real replica loops) ----
+
+def _fleet(params, tracker, rids, **router_kw):
+    reps = []
+    for rid in rids:
+        rep = FleetReplica(_engine(params), tracker, rid,
+                           heartbeat_s=0.05, poll_s=0.005, publish_s=0.1)
+        rep.start()
+        reps.append(rep)
+    router_kw.setdefault("stale_after_s", 0.5)
+    router_kw.setdefault("dead_after_s", 2.0)
+    router_kw.setdefault("poll_s", 0.005)
+    return reps, _router(tracker, **router_kw)
+
+
+def test_fleet_generates_token_identical_with_affinity(params):
+    tracker = InMemoryStateTracker()
+    reps, router = _fleet(params, tracker, ("r1", "r2"))
+    try:
+        prompts = _prompts(6, seed=3)
+        sessions = [f"s{i % 2}" for i in range(6)]
+        reqs = [router.submit(p, max_new_tokens=6, session=s)
+                for p, s in zip(prompts, sessions)]
+        router.run_until_idle(timeout_s=120.0)
+        oracle = _engine(params)
+        for p, r in zip(prompts, reqs):
+            assert r.generated == oracle.generate(p, max_new_tokens=6)
+            assert r.finish_reason is not None
+        snap = router.fleet_snapshot()
+        assert snap["alive"] == 2
+        assert set(snap["affinity"]) == {"s0", "s1"}
+        # each session rode exactly one replica
+        assert snap["completed_total"] == 6
+        assert snap["requeued_total"] == 0
+    finally:
+        for rep in reps:
+            rep.stop()
+
+
+def test_uiserver_fleet_surface(params):
+    from deeplearning4j_tpu.ui import UiServer
+
+    tracker = InMemoryStateTracker()
+    reps, router = _fleet(params, tracker, ("r1",))
+    router.start()
+    server = UiServer()
+    server.attach_fleet(router, generate_timeout_s=60.0)
+    server.start(port=0)
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        prompt = [1, 2, 3, 4]
+        body = json.dumps({"prompt": prompt, "max_new_tokens": 4,
+                           "session": "sess-a"}).encode()
+        with urllib.request.urlopen(urllib.request.Request(
+                base + "/api/generate", data=body,
+                headers={"Content-Type": "application/json"}),
+                timeout=60) as resp:
+            out = json.loads(resp.read())
+        assert out["tokens"] == _engine(params).generate(
+            prompt, max_new_tokens=4)
+        assert out["n"] == 4 and out["prompt_len"] == 4
+        with urllib.request.urlopen(base + "/api/fleet",
+                                    timeout=10) as resp:
+            fleet = json.loads(resp.read())
+        assert fleet["alive"] == 1
+        assert fleet["affinity"] == {"sess-a": "r1"}
+        assert fleet["replicas"][0]["replica_id"] == "r1"
+        assert fleet["completed_total"] == 1
+        # a non-string session is a 400, not a routed request
+        bad = json.dumps({"prompt": prompt, "session": 7}).encode()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                base + "/api/generate", data=bad,
+                headers={"Content-Type": "application/json"}), timeout=10)
+        assert ei.value.code == 400
+    finally:
+        server.stop()
+        router.stop()
+        for rep in reps:
+            rep.stop()
+
+
+def test_fleet_start_stop_leaves_thread_count_stable(params):
+    tracker = InMemoryStateTracker()
+    engine = _engine(params)
+    before = threading.active_count()
+    for _ in range(3):
+        rep = FleetReplica(engine, tracker, "r1", heartbeat_s=0.02,
+                           poll_s=0.005, publish_s=0.05)
+        router = _router(tracker, stale_after_s=0.5, dead_after_s=2.0,
+                         poll_s=0.005)
+        rep.start()
+        router.start()
+        time.sleep(0.05)
+        router.stop()
+        rep.stop()
+    assert threading.active_count() == before
+
+
+# --------------------------------------------- the chaos pin (tier-1) ----
+
+def _spawn_replica(address, rid):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.Popen(
+        [sys.executable, "-m", "deeplearning4j_tpu.serve.fleet",
+         "--replica", "--tracker", address, "--replica-id", rid,
+         "--synthetic", SYNTH, "--seed", "0", "--serve-dtype", "none",
+         "--slots", "2", "--max-len", str(MAXLEN),
+         "--heartbeat-s", "0.05", "--poll-s", "0.005",
+         "--publish-s", "0.1"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=REPO, env=env)
+
+
+def _wait_ready(proc, timeout_s=120.0):
+    box = {}
+    ready = threading.Event()
+
+    def scan():
+        for line in proc.stdout:
+            if line.startswith("FLEET_REPLICA_READY"):
+                box["rid"] = line.split()[1]
+                ready.set()
+                break
+        ready.set()
+        # keep draining so the child never blocks on a full pipe
+        proc.stdout.read()
+
+    threading.Thread(target=scan, daemon=True).start()
+    # wait on the READY event, not the thread: the scanner keeps
+    # draining the pipe for the life of the subprocess
+    ready.wait(timeout_s)
+    assert box.get("rid"), "replica subprocess did not become ready"
+    return box["rid"]
+
+
+def test_chaos_kill9_mid_stream_completes_token_identical(params):
+    """The acceptance pin: two subprocess replicas over the real TCP
+    tracker, SIGKILL one mid-stream — every accepted request completes
+    with zero client-visible failures, the routed greedy output is
+    token-identical to the single-engine oracle, ``fleet_replica_down``
+    fires off the heartbeat gauge and resolves once the burial sentinel
+    retires the series, and the cold-started replacement subprocess
+    rejoins the membership."""
+    from deeplearning4j_tpu.telemetry.alerts import (
+        AlertEngine,
+        default_rules,
+    )
+    from deeplearning4j_tpu.telemetry.history import MetricsHistory
+
+    prompts = _prompts(6, seed=11)
+    max_new = 12
+    oracle = _engine(params)
+    expected = [oracle.generate(p, max_new_tokens=max_new)
+                for p in prompts]
+
+    procs = {}
+    spawned = []
+    with StateTrackerServer() as tsrv:
+        addr = tsrv.address
+        for rid in ("rA", "rB"):
+            procs[rid] = _spawn_replica(addr, rid)
+        for rid, proc in procs.items():
+            assert _wait_ready(proc) == rid
+
+        def cold_start(_failed_rid):
+            proc = _spawn_replica(addr, "rC")
+            procs["rC"] = proc
+            spawned.append(proc)
+
+        reg = MetricsRegistry()
+        client = StateTrackerClient(tsrv.address)
+        router = _router(tracker=client, registry=reg,
+                         stale_after_s=0.3, dead_after_s=1.0,
+                         poll_s=0.01, cold_start=cold_start)
+        # watchtower view over the ROUTER's registry: the absence rule
+        # must fire between the kill and the burial sentinel
+        rule = dataclasses.replace(
+            [r for r in default_rules()
+             if r.name == "fleet_replica_down"][0],
+            stale_s=0.4)
+        hist = MetricsHistory(registry=reg)
+        alerts = AlertEngine(hist, rules=[rule],
+                             registry=MetricsRegistry())
+        try:
+            _step_until(router,
+                        lambda: router.fleet_snapshot()["alive"] >= 2,
+                        timeout_s=60.0, msg="both replicas alive")
+            reqs = [router.submit(p, max_new_tokens=max_new)
+                    for p in prompts]
+            killed = False
+            down_fired = False
+            deadline = time.monotonic() + 180.0
+            while router.has_work():
+                assert time.monotonic() < deadline, "chaos did not drain"
+                router.step()
+                hist.sample_once()
+                if any(s["state"] == "firing"
+                       for s in alerts.evaluate_once()):
+                    down_fired = True
+                if not killed and any(
+                        r.t_done is None and r.replica == "rA"
+                        and len(r.generated) >= 1 for r in reqs):
+                    # rA is mid-stream on an unfinished request (and,
+                    # with 3 dispatches on 2 slots, necessarily holds
+                    # more unfinished work): kill -9, no goodbye
+                    os.kill(procs["rA"].pid, signal.SIGKILL)
+                    killed = True
+            assert killed, "victim was never mid-stream"
+            # zero client-visible failures, token-identical throughout
+            for req, exp in zip(reqs, expected):
+                assert req.t_done is not None
+                assert req.generated == exp
+                assert req.finish_reason == "max_new_tokens"
+            snap = router.fleet_snapshot()
+            assert snap["failed_replicas"] == ["rA"]
+            assert snap["requeued_total"] >= 1
+            assert down_fired, "fleet_replica_down never fired"
+            # burial retired the heartbeat series to the -1 sentinel:
+            # the rule resolves instead of firing forever
+            hist.sample_once()
+            final = {s["rule"]: s["state"]
+                     for s in alerts.evaluate_once()}
+            assert final["fleet_replica_down"] != "firing"
+            # the replacement spawned by the burial joins the fleet
+            assert spawned, "cold_start never ran"
+            _step_until(
+                router,
+                lambda: any(r["replica_id"] == "rC"
+                            and r["state"] == "alive"
+                            for r in router.fleet_snapshot()["replicas"]),
+                timeout_s=120.0, msg="replacement rC alive")
+        finally:
+            for proc in procs.values():
+                proc.kill()
+            client.close()

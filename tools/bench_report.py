@@ -57,6 +57,15 @@ stay raw, regression-gating for that pair is SUPPRESSED (flag
 reference must never silently normalize (or silently gate) anything.
 Rounds without the reference row (pre-ISSUE-16) behave exactly as
 before.
+
+ISSUE 19: the fleet stage's ``latency``/``goodput`` blocks land through
+the existing extractors as ``fleet_latency_*`` (LOWER) and
+``fleet_goodput_rps`` (HIGHER); its ``requeue`` block contributes
+``fleet_requeue_to_first_token_ms`` — how long a requeued client stream
+stalls between its replica dying and its first post-requeue token —
+tracked LOWER-IS-BETTER, so a recovery-latency regression (slower death
+detection, slower cold start, slower re-prefill) trips
+``--fail-on-regression`` like any latency row.
 """
 
 from __future__ import annotations
@@ -88,7 +97,8 @@ _LOWER_IS_BETTER_RE = re.compile(
     r"_profile_(?:peak_bytes|collective_bytes)$"
     r"|_latency_(?:p50|p95|p99|mean)_ms$"
     r"|_collective_wire_bytes$"
-    r"|_inter_token_p99_ms(?:_chunked|_unchunked)?$")
+    r"|_inter_token_p99_ms(?:_chunked|_unchunked)?$"
+    r"|_requeue_to_first_token_ms$")
 
 # ISSUE 16 bench-noise carry-over: the fixed reference micro-stage's row.
 # Its drift between two rounds is machine noise by construction (the
@@ -203,6 +213,26 @@ def _goodput_metrics(detail: Dict) -> Dict[str, float]:
     return out
 
 
+def _requeue_metrics(detail: Dict) -> Dict[str, float]:
+    """Fleet recovery-latency row (ISSUE 19): a stage detail carrying a
+    ``requeue`` block (the fleet bench's chaos phase) contributes
+    ``<stage>_requeue_to_first_token_ms`` — the mean gap between a
+    replica death requeueing a request and that request's first token
+    from its replacement dispatch, tracked LOWER-IS-BETTER."""
+    out: Dict[str, float] = {}
+    for key, val in detail.items():
+        if not key.endswith("_detail") or not isinstance(val, dict):
+            continue
+        rq = val.get("requeue")
+        if not isinstance(rq, dict):
+            continue
+        v = rq.get("requeue_to_first_token_ms")
+        if isinstance(v, (int, float)):
+            out[f"{key[: -len('_detail')]}_requeue_to_first_token_ms"] = \
+                float(v)
+    return out
+
+
 def _fastpath_metrics(detail: Dict) -> Dict[str, float]:
     """Serve fast-path twin rows (ISSUE 16): a stage detail carrying a
     ``fast_path`` block (the serving bench's prefix/spec/chunked A/Bs)
@@ -253,6 +283,7 @@ def load_rounds(bench_dir: str) -> List[Dict]:
             metrics.update(_latency_metrics(detail))
             metrics.update(_wire_metrics(detail))
             metrics.update(_goodput_metrics(detail))
+            metrics.update(_requeue_metrics(detail))
             metrics.update(_fastpath_metrics(detail))
             rounds.append({"round": int(m.group(1)), "source": "parsed",
                            "metrics": metrics,

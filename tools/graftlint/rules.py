@@ -409,9 +409,12 @@ def env_read(ctx: ModuleContext) -> Iterable[Finding]:
     ``DL4J_TPU_MOE_IMPL`` (parallel/moe.py dispatch chain:
     alltoall | alltoall_2d | replicated),
     ``DL4J_TPU_UPDATE_SHARDING`` (optimize/updaters.py ZeRO
-    update-sharding chain), and ``DL4J_TPU_RUNPROF`` /
+    update-sharding chain), ``DL4J_TPU_RUNPROF`` /
     ``DL4J_TPU_RUNPROF_DIR`` (telemetry/runprof.py ``runprof=`` seam
-    default + session dump directory), all read host-side at
+    default + session dump directory), and ``DL4J_TPU_FLEET_STALE_S`` /
+    ``DL4J_TPU_FLEET_DEAD_S`` / ``DL4J_TPU_FLEET_POLL_S`` /
+    ``DL4J_TPU_FLEET_HEARTBEAT_S`` (serve/router.py + serve/fleet.py
+    membership timing defaults), all read host-side at
     trace/resolve time, never inside a traced body). Ad-hoc env reads are invisible config:
     they fork behavior between hosts and leak into traced code paths
     where a retrace won't see the change."""
