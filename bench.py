@@ -2866,6 +2866,68 @@ def measure_runprof() -> float:
     return overhead_pct
 
 
+def measure_autotune() -> float:
+    """ISSUE 20 roofline-guided autotuner A/B: run the real two-phase
+    search (AOT-profile every candidate, prune strictly-dominated
+    configs without ever executing them, wall-clock only the Pareto
+    frontier with paired-median timing) on the composed LM step and the
+    decode engine, and report the winner's tuned-vs-default step-time
+    ratio. The LM seam's candidates flow through the SAME ``tuned=``
+    seam the cache feeds (make_single_device_train_step(tuned=cfg)), so
+    the headline measures the production adoption path, not a side
+    harness, and every candidate that cannot reproduce the default's
+    numerics is disqualified before it can win.
+
+    Headline = LM tuned_vs_default, which is >= 1.0 by construction
+    (the default config is always a candidate, so the worst case is
+    "tuning found nothing better"). On a CPU round the margin can sit
+    inside the ref_micro +/-10% noise band; the detail marks that case
+    informational instead of claiming a win.
+    """
+    from deeplearning4j_tpu.tune import seams as tune_seams
+    from deeplearning4j_tpu.tune.search import search
+    from deeplearning4j_tpu.tune.space import get_space
+
+    fast = _fast()
+    repeats = 3 if fast else 5
+
+    def _run(h):
+        return search(get_space(h.seam), h.context, h.default_config,
+                      h.compile_fn, h.measure_fn, h.outputs_match,
+                      repeats=repeats)
+
+    lm = _run(tune_seams.lm_seam(seq_len=128 if fast else 256,
+                                 n_layers=1 if fast else 2))
+    sv = _run(tune_seams.serve_seam(n_prompts=3 if fast else 6,
+                                    max_new_tokens=4 if fast else 8))
+
+    detail: dict = {"seams": {}, "repeats": repeats}
+    for res in (lm, sv):
+        detail["seams"][res.seam] = {
+            "default": res.default_config,
+            "winner": res.winner_config,
+            "tuned_vs_default": (round(res.tuned_vs_default, 4)
+                                 if res.tuned_vs_default else None),
+            "counts": res.counts,
+            "rank_correlation": (round(res.rank_correlation, 3)
+                                 if res.rank_correlation is not None
+                                 else None),
+        }
+    headline = lm.tuned_vs_default or 1.0
+    # informational flag: a sub-10% margin is within the band
+    # bench_report treats as machine drift (the ref_micro reference),
+    # so a CPU round should read the headline as "search ran, default
+    # held" rather than as a measured speedup
+    detail["headline_within_noise"] = bool(headline - 1.0 < 0.10)
+    detail["note"] = (
+        "tuned_vs_default >= 1.0 by construction (default is always a "
+        "candidate); headline_within_noise=true means the margin is "
+        "inside the ref_micro +/-10% drift band and is informational"
+    )
+    print("STAGE_DETAIL " + json.dumps(detail), flush=True)
+    return headline
+
+
 
 # ---------------------------------------------------------------------------
 # Stage orchestration. Each stage is `python bench.py --stage NAME`, run by
@@ -2981,6 +3043,8 @@ def run_stage(name: str) -> float:
         return measure_observability()
     if name == "runprof":
         return measure_runprof()
+    if name == "autotune":
+        return measure_autotune()
     if name == "word2vec":
         if _fast():
             return measure_word2vec(n_sentences=100, sent_len=20, vocab=200)
@@ -3089,6 +3153,7 @@ STAGES = [
     ("fleet", 300),
     ("observability", 240),
     ("runprof", 260),
+    ("autotune", 420),
     ("cpu_word2vec", 150),
     ("word2vec", 120),
     ("word2vec_sharded", 150),
@@ -3175,6 +3240,10 @@ def main() -> None:
         elif stage == "comm_overlap":
             # strict/overlapped pp step-time ratio (>1 = overlap faster)
             key = f"{stage}_overlap_vs_strict"
+        elif stage == "autotune":
+            # default/tuned LM step-time ratio (>1 = search found a
+            # faster numerics-identical config; 1.0 = default held)
+            key = f"{stage}_tuned_vs_default"
         else:
             key = f"{stage}_samples_per_sec"
         remaining = deadline - time.monotonic()
@@ -3224,6 +3293,13 @@ def main() -> None:
         if "ring" in co:
             detail["comm_overlap_ring_prefetch_vs_rotate_after"] = \
                 co["ring"]["prefetch_vs_rotate_after"]
+    at = detail.get("autotune_detail", {})
+    sv_ratio = ((at.get("seams") or {}).get("serve") or {}).get(
+        "tuned_vs_default")
+    if sv_ratio:
+        # lift the serve engine's tuned-vs-default to a tracked row next
+        # to the LM headline (both HIGHER-IS-BETTER, >= 1.0 by design)
+        detail["autotune_serve_tuned_vs_default"] = sv_ratio
     rp = detail.get("runprof_detail", {})
     if rp and rp.get("measured_mfu") is not None:
         # lift the cross-check MFU to a tracked top-level row so

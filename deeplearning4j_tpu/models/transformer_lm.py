@@ -254,17 +254,23 @@ def selected_moe_impl(mesh: Mesh, n_tokens: int,
 
 def dense_loss_fn(n_heads: int, top_k: int = 2, aux_weight: float = 1e-2,
                   attn_impl: Optional[str] = None,
-                  with_metrics: bool = False):
+                  with_metrics: bool = False,
+                  attn_blocks: Optional[tuple] = None):
     """Single-device reference loss (dense MoE; attention through the core
     seam). ``attn_impl=None`` auto-gates by shape — blockwise flash for long
     T, dense for short — so the flagship bench runs the fast core without
     edits; parity oracles pass ``attn_impl="dense"`` to pin the
     materializing reference. ``with_metrics`` swaps in the
-    (loss, metrics)-returning twin for telemetry-threaded steps."""
+    (loss, metrics)-returning twin for telemetry-threaded steps.
+    ``attn_blocks=(block_q, block_k)`` overrides the blockwise tile policy
+    (``ops.flash_attention.default_block_policy``) — the autotuner's knob
+    (ISSUE 20); ignored by the dense/pallas cores."""
+    bq, bk = attn_blocks or (None, None)
     kwargs = dict(
         n_heads=n_heads,
         attn_core=lambda q, k, v: attention_core(q, k, v, causal=True,
-                                                 impl=attn_impl),
+                                                 impl=attn_impl,
+                                                 block_q=bq, block_k=bk),
         moe_fn=lambda rw, ex, x: dense_moe(rw, ex, x, top_k),
         aux_weight=aux_weight,
     )
@@ -278,7 +284,8 @@ def composed_loss_fn(mesh: Mesh, n_heads: int, capacity: int,
                      attn_impl: Optional[str] = None,
                      moe_impl: Optional[str] = None,
                      with_metrics: bool = False,
-                     ring_prefetch: bool = True):
+                     ring_prefetch: bool = True,
+                     attn_blocks: Optional[tuple] = None):
     """Loss with the parallel strategies the mesh's axes call for:
     "data" → batch sharding (GSPMD), "sp" → ring attention over the
     sequence, "expert" → expert-parallel MoE dispatch (grouped: any
@@ -297,8 +304,13 @@ def composed_loss_fn(mesh: Mesh, n_heads: int, capacity: int,
     activations, so it reports the same global balance the dense oracle
     sees, and the capacity paths add ``moe_dropped_frac`` (the overflow
     share under the resolved dispatch's sub-shard semantics).
+    ``attn_blocks=(block_q, block_k)`` overrides the blockwise tile
+    policy on the UNSHARDED attention core only (ISSUE 20); the ring
+    path's per-rotated-block core keeps ``default_block_policy`` — its
+    block shapes are set by the shard geometry, not this knob.
     """
     names = mesh.axis_names
+    bq, bk = attn_blocks or (None, None)
     if SEQ_AXIS in names:
         attn_core_fn = lambda q, k, v: ring_attention(  # noqa: E731
             q, k, v, mesh, SEQ_AXIS, causal=True,
@@ -306,7 +318,7 @@ def composed_loss_fn(mesh: Mesh, n_heads: int, capacity: int,
             attn_impl=attn_impl, prefetch=ring_prefetch)
     else:
         attn_core_fn = lambda q, k, v: attention_core(  # noqa: E731
-            q, k, v, causal=True, impl=attn_impl)
+            q, k, v, causal=True, impl=attn_impl, block_q=bq, block_k=bk)
     moe_drop_fn = None
     if EXPERT_AXIS in names:
         token_axes = tuple(a for a in (DATA_AXIS, SEQ_AXIS) if a in names)
@@ -576,7 +588,8 @@ def make_composed_train_step(mesh: Mesh, n_heads: int, capacity: int,
                              with_metrics: bool = False,
                              donate: bool = False, guard=None,
                              profile=None, optimizer=None,
-                             ring_prefetch: bool = True, runprof=None):
+                             ring_prefetch: bool = True, runprof=None,
+                             tuned=None, tune_context=None):
     """SGD step over the composed mesh: step(params, tokens, targets) ->
     (new_params, loss). Shard inputs with shard_lm_params/shard_lm_batch
     first; GSPMD + the shard_map transposes insert every collective
@@ -628,14 +641,38 @@ def make_composed_train_step(mesh: Mesh, n_heads: int, capacity: int,
     replicated leaves and the params allgather back, parity ≤1e-6 vs
     replicated pinned in tests/test_updaters.py. Moments donate, thread
     through the ``guard=`` skip-select bitwise, and checkpoint through
-    ``updaters.canonical_opt_state``."""
+    ``updaters.canonical_opt_state``.
+
+    ``tuned=`` (ISSUE 20) adopts autotuner knobs: an explicit config dict
+    wins, ``True`` consults the tuning cache under ``tune_context`` (a
+    ``tune.seams`` context dict — cache keys are shape-fingerprinted),
+    default ``None`` consults it only when ``DL4J_TPU_TUNED`` is set.
+    Adopted knobs: ``block_q``/``block_k`` (blockwise attention tiles),
+    ``moe_impl`` (only when the ``moe_impl=`` arg is None — an explicit
+    arg outranks the cache), ``capacity_factor`` (scales ``capacity``,
+    >= 1.0). Every cache adoption is pinned numerically identical to the
+    default-config step in tests/test_tune.py — tuning changes speed,
+    never losses."""
+    import math
+
     from deeplearning4j_tpu.optimize.guardrails import GuardConfig
     from deeplearning4j_tpu.optimize.updaters import OptimizerConfig
+    from deeplearning4j_tpu.tune.cache import resolve_step_tuning
+
+    tuning = resolve_step_tuning(tuned, tune_context,
+                                 ("flash_attention", "moe"))
+    attn_blocks = ((int(tuning["block_q"]), int(tuning["block_k"]))
+                   if "block_q" in tuning else None)
+    if moe_impl is None:
+        moe_impl = tuning.get("moe_impl")
+    capacity = int(math.ceil(
+        capacity * float(tuning.get("capacity_factor", 1.0))))
 
     loss_fn = composed_loss_fn(mesh, n_heads, capacity, top_k, aux_weight,
                                attn_impl=attn_impl, moe_impl=moe_impl,
                                with_metrics=with_metrics,
-                               ring_prefetch=ring_prefetch)
+                               ring_prefetch=ring_prefetch,
+                               attn_blocks=attn_blocks)
     label = "lm_composed[" + "x".join(mesh.axis_names) + "]"
     opt_cfg = OptimizerConfig.coerce(optimizer)
     if opt_cfg is not None:
@@ -656,7 +693,8 @@ def make_single_device_train_step(n_heads: int, lr: float = 0.1,
                                   with_metrics: bool = False,
                                   donate: bool = False, guard=None,
                                   profile=None, optimizer=None,
-                                  runprof=None):
+                                  runprof=None, tuned=None,
+                                  tune_context=None):
     """The dense twin of make_composed_train_step (parity oracle when
     called with ``attn_impl="dense"``; the flagship single-chip bench path
     with the default auto core). ``with_metrics``/``donate``/``guard``/
@@ -667,12 +705,23 @@ def make_single_device_train_step(n_heads: int, lr: float = 0.1,
     step carries the opt state (``init_lm_opt_state(optimizer, params)``)
     as a second argument/output; there is no dp axis here, so
     ``update_sharding="sharded"`` is rejected rather than silently
-    running the replicated update under a ZeRO label."""
+    running the replicated update under a ZeRO label.
+
+    ``tuned=`` (ISSUE 20) as on the composed builder; the single-device
+    step adopts the ``flash_attention`` seam only (``block_q``/``block_k``
+    blockwise tiles), parity <= 1e-5 with ``default_block_policy`` pinned
+    in tests/test_flash_attention.py."""
     from deeplearning4j_tpu.optimize.guardrails import GuardConfig
     from deeplearning4j_tpu.optimize.updaters import OptimizerConfig
+    from deeplearning4j_tpu.tune.cache import resolve_step_tuning
+
+    tuning = resolve_step_tuning(tuned, tune_context, ("flash_attention",))
+    attn_blocks = ((int(tuning["block_q"]), int(tuning["block_k"]))
+                   if "block_q" in tuning else None)
 
     loss_fn = dense_loss_fn(n_heads, top_k, aux_weight, attn_impl=attn_impl,
-                            with_metrics=with_metrics)
+                            with_metrics=with_metrics,
+                            attn_blocks=attn_blocks)
     opt_cfg = OptimizerConfig.coerce(optimizer)
     if opt_cfg is not None:
         if opt_cfg.sharded:

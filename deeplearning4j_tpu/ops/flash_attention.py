@@ -316,10 +316,26 @@ blockwise_attention.defvjp(_blockwise_vjp_fwd, _blockwise_vjp_bwd)
 
 # ------------------------------------------- sharded-seam block partials ----
 
-def _pick_block(t: int) -> int:
-    """Largest tile <= _DEFAULT_BLOCK dividing t (t itself if none does)."""
+def default_block_policy(t: int) -> int:
+    """Default blockwise tile for sequence length ``t`` (ISSUE 20).
+
+    The policy: the largest tile <= ``_DEFAULT_BLOCK`` (512) that divides
+    ``t``, falling back to ``t`` itself (one block) when none does —
+    a forced blockwise core on a non-block-aligned T degrades to a single
+    block rather than a reshape error. 512 is the measured sweet spot on
+    the TPU scan path (module docstring); the autotuner
+    (deeplearning4j_tpu/tune/) searches (block_q, block_k) around this
+    default, and any legal pair is loss+grad parity <= 1e-5 with it
+    (tests/test_flash_attention.py pins the gate every tuned config rides
+    through). This is the ONE place the default tile comes from — every
+    internal ``block_q/block_k=None`` resolves here.
+    """
     blk = min(_DEFAULT_BLOCK, t)
     return blk if t % blk == 0 else t
+
+
+# historical internal name, kept for grep continuity
+_pick_block = default_block_policy
 
 
 def blockwise_block_partials(q: Array, k: Array, v: Array, q_offset=0,
@@ -342,8 +358,8 @@ def blockwise_block_partials(q: Array, k: Array, v: Array, q_offset=0,
     """
     b, h, tq, d = q.shape
     tk = k.shape[2]
-    bq = block_q or _pick_block(tq)
-    bk = block_k or _pick_block(tk)
+    bq = block_q or default_block_policy(tq)
+    bk = block_k or default_block_policy(tk)
     nq, nk = tq // bq, tk // bk
     scale = 1.0 / (d ** 0.5)
     kb = k.reshape(b, h, nk, bk, d).transpose(2, 0, 1, 3, 4)
@@ -408,13 +424,17 @@ def _flash_attention_tpu(q: Array, k: Array, v: Array, causal: bool) -> Array:
 # ------------------------------------------------------------- dispatcher ----
 
 def attention_core(q: Array, k: Array, v: Array, causal: bool = False,
-                   impl: Optional[str] = None) -> Array:
+                   impl: Optional[str] = None,
+                   block_q: Optional[int] = None,
+                   block_k: Optional[int] = None) -> Array:
     """The ATTENTION layer's dense core: picks the fastest correct
     implementation for the shape/platform. ``impl`` forces a core for THIS
     call (the per-call seam models/transformer_lm.py exposes as
     ``attn_impl=``); otherwise the set_attention_impl/env/auto chain
-    decides. All paths compute the identical function; parity is pinned in
-    tests/test_flash_attention.py."""
+    decides. ``block_q``/``block_k`` override the blockwise tile policy
+    (``default_block_policy``) on the blockwise path — the autotuner's
+    knob (ISSUE 20); the other paths ignore them. All paths compute the
+    identical function; parity is pinned in tests/test_flash_attention.py."""
     if impl is not None and impl not in _IMPLS:
         raise ValueError(f"unknown attention impl {impl!r}; "
                          "options: " + ", ".join(_IMPLS))
@@ -422,8 +442,8 @@ def attention_core(q: Array, k: Array, v: Array, causal: bool = False,
     if impl == "flash":
         return _flash_attention_tpu(q, k, v, causal)
     if impl == "blockwise":
-        # _pick_block: a forced blockwise core on a non-block-aligned T
-        # falls back to one block rather than a reshape error
-        blk = _pick_block(q.shape[2])
-        return blockwise_attention(q, k, v, causal, blk, blk)
+        t = q.shape[2]
+        bq = block_q or default_block_policy(t)
+        bk = block_k or default_block_policy(t)
+        return blockwise_attention(q, k, v, causal, bq, bk)
     return dense_attention(q, k, v, causal)

@@ -358,7 +358,8 @@ def make_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
                              batch_axis: "str | None" = None,
                              with_metrics: bool = False, guard=None,
                              profile=None, optimizer=None,
-                             overlap: bool = False, runprof=None):
+                             overlap: bool = False, runprof=None,
+                             tuned=None, tune_context=None):
     """SGD train step over the pipelined stack.
 
     loss = mean over microbatches of ``loss_fn(y, labels_mb)`` on the
@@ -399,6 +400,13 @@ def make_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
     AND updated params are bit-identical to the strict schedule at the
     same 0-compile steady retrace budget, so the knob is a pure-schedule
     A/B (bench ``comm_overlap`` stage measures both).
+
+    ``tuned=`` (ISSUE 20) adopts the autotuner's ``pipeline`` seam:
+    ``overlap`` (bitwise-safe — see above) when the ``overlap=`` arg was
+    left at its default. The space's ``microbatches`` knob shapes the
+    DATA (x_mbs/y_mbs), so the caller's loader applies it — this factory
+    only adopts schedule knobs. Explicit dict > cache under
+    ``tune_context`` > ``DL4J_TPU_TUNED`` env > off (tune/cache.py).
     """
     from deeplearning4j_tpu.optimize.guardrails import (
         GuardConfig,
@@ -407,6 +415,11 @@ def make_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
     from deeplearning4j_tpu.optimize.updaters import OptimizerConfig
     from deeplearning4j_tpu.telemetry.runprof import maybe_runprof
     from deeplearning4j_tpu.telemetry.xprofile import maybe_profiled
+    from deeplearning4j_tpu.tune.cache import resolve_step_tuning
+
+    tuning = resolve_step_tuning(tuned, tune_context, ("pipeline",))
+    if not overlap and "overlap" in tuning:
+        overlap = bool(tuning["overlap"])
 
     guard = GuardConfig.coerce(guard)
     label = (f"pipeline[{axis}" + (f"x{batch_axis}]" if batch_axis else "]")

@@ -198,9 +198,26 @@ class DecodeEngine:
                  prefix_cache=False, prefix_page_tokens: int = 16,
                  prefix_cache_pages: int = 256,
                  prefill_chunk: Optional[int] = None,
-                 speculative=None, runprof=None):
+                 speculative=None, runprof=None, tuned=None):
         from deeplearning4j_tpu.telemetry.registry import default_registry
         from deeplearning4j_tpu.telemetry.runprof import resolve_runprof
+
+        # tuned= (ISSUE 20): adopt the autotuner's "serve" seam —
+        # min_bucket and slots (scheduling knobs; greedy decode stays
+        # token-identical, pinned in tests/test_tune.py). The engine
+        # builds its own cache-key context from the param dims it already
+        # knows, so a bare tuned=True works here (unlike the step
+        # factories, which need tune_context=). Explicit dict > cache >
+        # DL4J_TPU_TUNED env > off; a dict also serves as explicit knobs.
+        if tuned is not False:
+            from deeplearning4j_tpu.tune.cache import resolve_step_tuning
+            from deeplearning4j_tpu.tune.seams import serve_context
+            ctx = serve_context(lm_dims(params), int(n_heads), int(max_len))
+            tuning = resolve_step_tuning(tuned, ctx, ("serve",))
+            if "min_bucket" in tuning:
+                min_bucket = int(tuning["min_bucket"])
+            if "slots" in tuning:
+                n_slots = int(tuning["slots"])
 
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")

@@ -568,6 +568,12 @@ def default_rules() -> List[AlertRule]:
       one replica hoarding load — session affinity gone pathological
       or a replica decoding far below fleet speed. Gauge is born on
       the first membership sweep with a nonzero mean depth.
+    - ``tune_cache_stale`` — autotuner (ISSUE 20): a cache lookup saw
+      entries stored under a knob-space version other than the live
+      ``tune.space`` version — those winners silently resolve to the
+      DEFAULT config until re-searched, so the speedup they promised
+      is gone. The ``tune_cache_stale_entries`` gauge is born on the
+      first lookup; 0 while every entry is current.
     """
     return [
         AlertRule(
@@ -672,6 +678,15 @@ def default_rules() -> List[AlertRule]:
             description="max/mean fleet replica queue depth above 3x "
                         "sustained — routing is piling work onto one "
                         "replica"),
+        AlertRule(
+            name="tune_cache_stale", kind="threshold",
+            metric="tune_cache_stale_entries", threshold=0.0, op=">",
+            for_s=0.0, severity="warning",
+            description="tuning-cache entries were searched under a "
+                        "different knob-space version than the live "
+                        "one (tune/space.py) — those winners resolve "
+                        "to defaults until re-tuned (run python -m "
+                        "deeplearning4j_tpu.tune --store)"),
     ]
 
 

@@ -1,0 +1,40 @@
+"""Roofline-guided autotuner (ISSUE 20, ROADMAP item 4).
+
+Turns the ISSUE 9 AOT cost model (``telemetry/xprofile.profile_compiled``)
+into a config search engine:
+
+- ``space.py``  — declarative per-seam search spaces (knob names, candidate
+  values, validity predicates) with a version stamp per space.
+- ``search.py`` — two-phase searcher: AOT-compile every candidate (no
+  execution), prune by roofline position + peak/wire-byte dominance, then
+  wall-clock-measure only the Pareto frontier with the bench's
+  paired-median discipline.
+- ``cache.py``  — persistent tuning cache (``TUNE_CACHE.json``) keyed by
+  (seam, model-shape fingerprint incl. mesh + backend, knob-space
+  version), consulted through the ``tuned=`` seam on the composed step
+  factories and ``DecodeEngine``.
+- ``seams.py``  — the concrete harnesses (context, default config,
+  compile_fn, measure_fn) per tunable seam, shared by the CLI and the
+  bench ``autotune`` stage.
+
+Tuning changes speed, never tokens or losses: the searcher gates every
+candidate on an output digest matching the default config's, and tier-1
+pins each cache adoption numerically identical to its default twin
+(tests/test_tune.py).
+"""
+
+from deeplearning4j_tpu.tune.cache import (  # noqa: F401
+    TuningCache,
+    default_cache_path,
+    fingerprint,
+    resolve_tuned,
+)
+from deeplearning4j_tpu.tune.search import SearchResult, search  # noqa: F401
+from deeplearning4j_tpu.tune.space import (  # noqa: F401
+    Knob,
+    SearchSpace,
+    get_space,
+    register_space,
+    space_names,
+    space_version,
+)

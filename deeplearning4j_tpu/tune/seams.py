@@ -1,0 +1,304 @@
+"""Concrete tuning harnesses per searchable seam (ISSUE 20).
+
+A :class:`SeamHarness` bundles what ``tune.search.search`` needs for one
+seam instance: the cache-key ``context`` (the canonical dict the winner
+is stored AND looked up under — the ``tuned=`` consumers must build the
+identical context, which is why the ``*_context`` builders live here),
+the ``default_config`` baseline, a ``compile_fn`` (one AOT
+``profile_compiled`` per candidate, zero execution), a ``measure_fn``
+(ONE timed, fenced execution per call — the searcher owns the
+paired-median loop), and the seam's ``outputs_match`` predicate at the
+tolerance its existing parity pins use (tokens exact for serve,
+loss/grads <= 1e-5 for the blockwise-attention reduction orders).
+
+Harnesses build jitted steps lazily and memoize them per config, so
+phase 2's repeated timings never recompile. Shapes default to
+CPU-friendly "fast" sizes; the CLI and the bench ``autotune`` stage both
+route through here.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple
+
+__all__ = [
+    "SeamHarness",
+    "flash_seam",
+    "lm_context",
+    "lm_seam",
+    "serve_context",
+    "serve_seam",
+]
+
+Config = Dict[str, Any]
+
+
+@dataclass
+class SeamHarness:
+    seam: str
+    context: Dict[str, Any]
+    default_config: Config
+    compile_fn: Callable[[Config], Any]
+    measure_fn: Callable[[Config], Tuple[float, Any]]
+    outputs_match: Callable[[Any, Any], bool]
+    label: str = ""
+    extras: Dict[str, Any] = field(default_factory=dict)
+
+
+# ------------------------------------------------------- context builders ----
+
+def _backend() -> str:
+    import jax
+    return jax.default_backend()
+
+
+def lm_context(n_heads: int, d_model: int, n_layers: int, vocab: int,
+               d_ff: int, n_experts: int, seq_len: int, batch: int,
+               mesh_shape: Optional[Dict[str, int]] = None) -> Dict[str, Any]:
+    """Cache-key context for the LM train-step seams. Any change — model
+    dims, workload shape, mesh, backend — is a fingerprint miss."""
+    return {
+        "kind": "lm",
+        "n_heads": n_heads, "d_model": d_model, "n_layers": n_layers,
+        "vocab": vocab, "d_ff": d_ff, "n_experts": n_experts,
+        "seq_len": seq_len, "batch": batch,
+        "mesh": mesh_shape or {},
+        "backend": _backend(),
+    }
+
+
+def serve_context(dims: Dict[str, int], n_heads: int,
+                  max_len: int) -> Dict[str, Any]:
+    """Cache-key context for the serve seam — built from ``lm_dims``
+    (recoverable from the params alone), so ``DecodeEngine(tuned=True)``
+    reconstructs it without caller help."""
+    return {
+        "kind": "serve",
+        "n_heads": int(n_heads), "max_len": int(max_len),
+        "d_model": int(dims["d_model"]), "n_layers": int(dims["n_layers"]),
+        "vocab": int(dims["vocab"]), "d_ff": int(dims["d_ff"]),
+        "n_experts": int(dims["n_experts"]),
+        "backend": _backend(),
+    }
+
+
+def _cfg_key(cfg: Config) -> Tuple:
+    return tuple(sorted(cfg.items()))
+
+
+def _timed(fn, *args):
+    """One fenced execution: dispatch + block, wall seconds + outputs."""
+    import jax
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(fn(*args))
+    return time.perf_counter() - t0, out
+
+
+# ------------------------------------------------------------- flash seam ----
+
+def flash_seam(seq_len: int = 1024, batch: int = 1, n_heads: int = 2,
+               head_dim: int = 64) -> SeamHarness:
+    """Standalone blockwise-attention value+grad step; knobs
+    (block_q, block_k) against ``default_block_policy``. Outputs match at
+    the blockwise parity tolerance (1e-5 — reduction order moves with the
+    tiling, bitwise is the wrong pin here)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.ops.flash_attention import (
+        blockwise_attention,
+        default_block_policy,
+    )
+
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (batch, n_heads, seq_len, head_dim)
+    q = jax.random.normal(kq, shape, jnp.float32)
+    k = jax.random.normal(kk, shape, jnp.float32)
+    v = jax.random.normal(kv, shape, jnp.float32)
+
+    steps: Dict[Tuple, Any] = {}
+
+    def _step(cfg: Config):
+        ck = _cfg_key(cfg)
+        if ck not in steps:
+            bq, bk = int(cfg["block_q"]), int(cfg["block_k"])
+
+            def loss(q, k, v):
+                o = blockwise_attention(q, k, v, causal=True,
+                                        block_q=bq, block_k=bk)
+                return jnp.mean(o * o)
+
+            steps[ck] = jax.jit(jax.value_and_grad(loss))
+        return steps[ck]
+
+    def compile_fn(cfg: Config):
+        from deeplearning4j_tpu.telemetry.xprofile import profile_compiled
+        return profile_compiled(
+            _step(cfg), q, k, v,
+            label=f"tune.flash[{cfg['block_q']}x{cfg['block_k']}]")
+
+    def measure_fn(cfg: Config):
+        dt, (loss, grads) = _timed(_step(cfg), q, k, v)
+        return dt, (float(loss), np.asarray(grads))
+
+    def outputs_match(a, b) -> bool:
+        return (abs(a[0] - b[0]) <= 1e-5
+                and bool(np.allclose(a[1], b[1], atol=1e-5, rtol=1e-5)))
+
+    pol = default_block_policy(seq_len)
+    return SeamHarness(
+        seam="flash_attention",
+        context={"kind": "flash", "seq_len": seq_len, "batch": batch,
+                 "n_heads": n_heads, "head_dim": head_dim,
+                 "backend": _backend()},
+        default_config={"block_q": pol, "block_k": pol},
+        compile_fn=compile_fn, measure_fn=measure_fn,
+        outputs_match=outputs_match, label="flash_attention")
+
+
+# ---------------------------------------------------------------- lm seam ----
+
+def lm_seam(vocab: int = 256, d_model: int = 64, n_heads: int = 2,
+            n_experts: int = 2, d_ff: int = 128, n_layers: int = 2,
+            seq_len: int = 256, batch: int = 2,
+            top_k: int = 2) -> SeamHarness:
+    """The single-device LM train step with a forced blockwise core,
+    searching the ``flash_attention`` seam THROUGH the factories'
+    ``tuned=`` dict path — the exact code path a cache adoption takes.
+    One SGD step from fixed params; outputs (loss, update-norm) match at
+    the blockwise 1e-5 tolerance."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.models.transformer_lm import (
+        init_lm_params,
+        make_single_device_train_step,
+    )
+    from deeplearning4j_tpu.ops.flash_attention import default_block_policy
+
+    params = init_lm_params(jax.random.PRNGKey(0), vocab, d_model, n_heads,
+                            n_experts, d_ff, n_layers=n_layers)
+    dk = jax.random.PRNGKey(1)
+    toks = jax.random.randint(dk, (batch, seq_len), 0, vocab)
+    tgts = jnp.roll(toks, -1, axis=1)
+
+    steps: Dict[Tuple, Any] = {}
+
+    def _step(cfg: Config):
+        ck = _cfg_key(cfg)
+        if ck not in steps:
+            steps[ck] = make_single_device_train_step(
+                n_heads, top_k=top_k, attn_impl="blockwise",
+                tuned=dict(cfg))
+        return steps[ck]
+
+    def compile_fn(cfg: Config):
+        from deeplearning4j_tpu.telemetry.xprofile import profile_compiled
+        return profile_compiled(
+            _step(cfg), params, toks, tgts,
+            label=f"tune.lm[{cfg['block_q']}x{cfg['block_k']}]")
+
+    def measure_fn(cfg: Config):
+        dt, (new_params, loss) = _timed(_step(cfg), params, toks, tgts)
+        upd = jax.tree_util.tree_reduce(
+            lambda a, b: a + b,
+            jax.tree_util.tree_map(
+                lambda n, p: float(jnp.sum(jnp.abs(n - p))),
+                new_params, params))
+        return dt, (float(loss), float(upd))
+
+    def outputs_match(a, b) -> bool:
+        return bool(np.allclose(np.asarray(a), np.asarray(b),
+                                atol=1e-5, rtol=1e-4))
+
+    pol = default_block_policy(seq_len)
+    return SeamHarness(
+        seam="flash_attention",
+        context=lm_context(n_heads, d_model, n_layers, vocab, d_ff,
+                           n_experts, seq_len, batch),
+        default_config={"block_q": pol, "block_k": pol},
+        compile_fn=compile_fn, measure_fn=measure_fn,
+        outputs_match=outputs_match, label="lm_single_device")
+
+
+# ------------------------------------------------------------- serve seam ----
+
+def serve_seam(vocab: int = 64, d_model: int = 32, n_heads: int = 2,
+               n_experts: int = 2, d_ff: int = 64, n_layers: int = 2,
+               max_len: int = 64, n_prompts: int = 6,
+               max_new_tokens: int = 8) -> SeamHarness:
+    """``DecodeEngine`` scheduling knobs (min_bucket, slots) over a fixed
+    greedy workload. The profiled executable is the bucketed prefill at
+    the candidate's smallest bucket against a cache sized by its slot
+    count — both knobs shape peak bytes. Outputs are the generated token
+    tuples; greedy decode is token-deterministic, so the match is EXACT
+    (the bitwise-style pin the serve parity tests use)."""
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.models.transformer_lm import (
+        init_kv_cache,
+        init_lm_params,
+        lm_dims,
+        make_prefill_step,
+    )
+
+    params = init_lm_params(jax.random.PRNGKey(0), vocab, d_model, n_heads,
+                            n_experts, d_ff, n_layers=n_layers)
+    dims = lm_dims(params)
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, vocab, size=int(n)))
+               for n in rng.integers(3, max_len // 2, size=n_prompts)]
+
+    prefill = make_prefill_step(n_heads)
+    head_dim = d_model // n_heads
+
+    def compile_fn(cfg: Config):
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.telemetry.xprofile import profile_compiled
+        bucket = int(cfg["min_bucket"])
+        slots = int(cfg["slots"])
+        cache = init_kv_cache(n_layers, slots, n_heads, head_dim, max_len)
+        padded = jnp.zeros((1, bucket), jnp.int32)
+        return profile_compiled(
+            prefill, params, cache, padded, 0, 0, jnp.float32(0.0),
+            jax.random.PRNGKey(0), 0,
+            label=f"tune.serve[b{bucket}s{slots}]")
+
+    engines: Dict[Tuple, Any] = {}
+
+    def _engine(cfg: Config):
+        from deeplearning4j_tpu.serve.engine import DecodeEngine
+        ck = _cfg_key(cfg)
+        if ck not in engines:
+            engines[ck] = DecodeEngine(
+                params, n_heads, n_slots=int(cfg["slots"]),
+                min_bucket=int(cfg["min_bucket"]), max_len=max_len,
+                serve_dtype=None, seed=0, tuned=False)
+        return engines[ck]
+
+    def measure_fn(cfg: Config):
+        eng = _engine(cfg)
+        t0 = time.perf_counter()
+        reqs = [eng.submit(p, max_new_tokens=max_new_tokens,
+                           temperature=0.0) for p in prompts]
+        while not all(r.done.is_set() for r in reqs):
+            eng.step()
+        dt = time.perf_counter() - t0  # graftlint: allow[untimed-dispatch] done events are set only after the engine's fenced token retirement (np.asarray per tick) — nothing is enqueued when the clock stops
+        return dt, tuple(tuple(r.generated) for r in reqs)
+
+    return SeamHarness(
+        seam="serve",
+        context=serve_context(dims, n_heads, max_len),
+        default_config={"min_bucket": 8, "slots": 4},
+        compile_fn=compile_fn,
+        measure_fn=measure_fn,
+        outputs_match=lambda a, b: a == b,
+        label="serve_engine",
+        extras={"params": params, "dims": dims, "max_len": max_len})

@@ -206,6 +206,13 @@ RULE_FIXTURES = {
         lambda: _two_sample_gauge("fleet_queue_imbalance_ratio",
                                   1.0, 1.0),
     ),
+    # ISSUE 20: a tuning-cache lookup counted entries searched under a
+    # stale knob-space version (they resolve to defaults — the tuned
+    # speedup is silently gone). 0 stale entries stays quiet.
+    "tune_cache_stale": (
+        lambda: _two_sample_gauge("tune_cache_stale_entries", 1.0, 1.0),
+        lambda: _two_sample_gauge("tune_cache_stale_entries", 0.0, 0.0),
+    ),
 }
 
 

@@ -193,3 +193,48 @@ def test_blockwise_memory_is_linear_in_t():
     assert dense_ratio > 3.0, f"dense temps grew only {dense_ratio:.2f}x"
     # and at equal T the blockwise program is much smaller
     assert blk2 < dn2 / 4, (blk2, dn2)
+
+
+# ------------------------------------------------- default block policy ----
+
+def test_default_block_policy_contract():
+    """The named default-tile policy (ISSUE 20): largest tile <= 512 that
+    divides T, else T itself — and it IS what the core resolves when no
+    explicit blocks are passed."""
+    from deeplearning4j_tpu.ops.flash_attention import default_block_policy
+
+    assert default_block_policy(2048) == 512
+    assert default_block_policy(512) == 512
+    assert default_block_policy(256) == 256
+    assert default_block_policy(192) == 192  # <=512: the whole T is one tile
+    assert default_block_policy(1536) == 512
+    assert default_block_policy(1000) == 1000  # 512 doesn't divide: one block
+    assert default_block_policy(193) == 193    # prime: one block, no error
+
+
+@pytest.mark.parametrize("bq,bk", [(64, 64), (64, 128), (128, 64),
+                                   (128, 256), (256, 64)])
+def test_any_legal_block_pair_loss_and_grad_parity(bq, bk):
+    """ISSUE 20 gate every tuned (block_q, block_k) rides through: any
+    legal pair is loss+grad parity <= 1e-5 with the default policy —
+    the tiling moves the reduction order, never the function."""
+    t = 256
+    q, k, v = _qkv(t=t, d=32)
+    tgt = jax.random.normal(jax.random.PRNGKey(7), q.shape)
+
+    def loss_with(blocks):
+        def f(q, k, v):
+            out = attention_core(q, k, v, causal=True, impl="blockwise",
+                                 block_q=blocks[0] if blocks else None,
+                                 block_k=blocks[1] if blocks else None)
+            return jnp.mean((out - tgt) ** 2)
+        return jax.jit(jax.value_and_grad(f, argnums=(0, 1, 2)))
+
+    l_def, g_def = loss_with(None)(q, k, v)
+    l_tun, g_tun = loss_with((bq, bk))(q, k, v)
+    assert abs(float(l_def) - float(l_tun)) < 1e-5
+    for a, b, name in zip(g_def, g_tun, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-4,
+                                   err_msg=f"d{name} mismatch at "
+                                           f"({bq},{bk})")

@@ -1,0 +1,493 @@
+"""Roofline-guided autotuner (ISSUE 20): search-space validity, the
+two-phase searcher's dominance pruning + numerics gating on synthetic
+cost models (no accelerator needed), the persistent tuning cache's
+fingerprint/staleness/corruption/concurrency contracts, and — the part
+that keeps tuning honest — numerics pins on every ``tuned=`` adoption
+path: single-device blockwise tiles (<=1e-5), composed ``alltoall_2d``
+dispatch (bitwise, matching test_moe's flat-vs-2d pin), the pipeline
+overlap schedule (bitwise), and the decode engine's scheduling knobs
+(token-identical greedy output).
+"""
+
+import json
+import logging
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.telemetry.registry import MetricsRegistry
+from deeplearning4j_tpu.telemetry.xprofile import StepProfile
+from deeplearning4j_tpu.tune.cache import (
+    TuningCache,
+    fingerprint,
+    resolve_step_tuning,
+    resolve_tuned,
+)
+from deeplearning4j_tpu.tune.search import search, spearman
+from deeplearning4j_tpu.tune.space import (
+    Knob,
+    SearchSpace,
+    get_space,
+    space_names,
+    space_version,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_tuning(monkeypatch):
+    """Keep every test hermetic: the env gate off, the cache path off the
+    repo's real TUNE_CACHE.json."""
+    monkeypatch.delenv("DL4J_TPU_TUNED", raising=False)
+    monkeypatch.delenv("DL4J_TPU_TUNE_CACHE", raising=False)
+
+
+# ------------------------------------------------------------- spaces ----
+
+def test_registered_spaces_cover_the_tunable_seams():
+    assert set(space_names()) >= {"flash_attention", "moe", "pipeline",
+                                  "serve"}
+    for seam in space_names():
+        space = get_space(seam)
+        assert space.size() > 0
+        assert isinstance(space_version(seam), int)
+
+
+def test_flash_space_rejects_non_dividing_and_oversize_blocks():
+    space = get_space("flash_attention")
+    ctx = {"seq_len": 256}
+    valid = [cfg for cfg, reason in space.configs(ctx) if reason is None]
+    # exactly the tiles that divide 256 and fit: {64,128,256}^2
+    assert len(valid) == 9
+    for cfg in valid:
+        assert 256 % cfg["block_q"] == 0 and 256 % cfg["block_k"] == 0
+    reasons = {json.dumps(cfg, sort_keys=True): reason
+               for cfg, reason in space.configs(ctx) if reason}
+    assert any("exceeds seq_len" in r for r in reasons.values())
+
+
+def test_moe_space_applies_the_factorization_predicate():
+    space = get_space("moe")
+    # prime expert axis: alltoall_2d invalid, flat alltoall fine
+    by_impl = {}
+    for cfg, reason in space.configs({"expert_devices": 3}):
+        by_impl.setdefault(cfg["moe_impl"], set()).add(reason is None)
+    assert by_impl["alltoall_2d"] == {False}
+    assert True in by_impl["alltoall"]
+    # composite axis >= 4: alltoall_2d becomes valid
+    ok = [cfg for cfg, reason in space.configs({"expert_devices": 4})
+          if reason is None and cfg["moe_impl"] == "alltoall_2d"]
+    assert ok
+    # a single device rejects every sharded dispatch
+    for cfg, reason in space.configs({"expert_devices": 1}):
+        if cfg["moe_impl"] != "replicated":
+            assert reason is not None
+
+
+def test_pipeline_and_serve_space_validity():
+    assert all(reason is None or "does not divide" in reason
+               for _, reason in get_space("pipeline").configs({"batch": 8}))
+    assert any(reason for _, reason
+               in get_space("pipeline").configs({"batch": 6}))
+    serve_reasons = [reason for cfg, reason
+                     in get_space("serve").configs({"max_len": 16})
+                     if cfg["min_bucket"] >= 16]
+    assert serve_reasons and all(r for r in serve_reasons)
+
+
+# ---------------------------------------------------- synthetic search ----
+
+def _profile(flops, nbytes, peak, wire=0.0):
+    return StepProfile(label="syn", platform="cpu", flops=flops,
+                       bytes_accessed=nbytes, peak_bytes=peak,
+                       collective_wire_bytes=wire, compile_seconds=0.01)
+
+
+def _syn_space(candidates=(1, 2, 3, 4), validity=None):
+    return SearchSpace(seam="synthetic", version=7,
+                       knobs=(Knob("x", tuple(candidates)),),
+                       validity=validity)
+
+
+def test_search_prunes_dominated_without_executing(tmp_path):
+    """x=3 is strictly dominated by x=2 in phase 1 and must NEVER reach
+    measure_fn; x=4 is invalid and must never reach compile_fn."""
+    profiles = {1: _profile(100.0, 100.0, 100), 2: _profile(50.0, 50.0, 50),
+                3: _profile(80.0, 80.0, 200)}
+    times = {1: 0.010, 2: 0.005}
+    compiled, measured = [], []
+
+    def compile_fn(cfg):
+        compiled.append(cfg["x"])
+        return profiles[cfg["x"]]
+
+    def measure_fn(cfg):
+        measured.append(cfg["x"])
+        return times[cfg["x"]], "same-output"
+
+    validity = lambda cfg, ctx: "four is right out" if cfg["x"] == 4 else None  # noqa: E731
+    res = search(_syn_space(validity=validity), {"seq_len": 1}, {"x": 1},
+                 compile_fn, measure_fn, repeats=3, out_dir=str(tmp_path))
+
+    assert 4 not in compiled and 3 not in measured and 4 not in measured
+    rec3 = next(r for r in res.candidates if r.config == {"x": 3})
+    assert rec3.pruned_by == {"x": 2} and rec3.pruned_reason
+    assert not rec3.measured
+    assert res.winner_config == {"x": 2}
+    assert res.tuned_vs_default == pytest.approx(2.0)
+    assert res.counts == {"total": 4, "invalid": 1, "profiled": 3,
+                          "pruned": 1, "measured": 2}
+    # the cost model predicted the measured order -> perfect rank corr
+    assert res.rank_correlation == pytest.approx(1.0)
+    # auditable decisions file, schema'd
+    rec = json.loads((tmp_path / "tuning_synthetic.json").read_text())
+    assert rec["schema"] == "dl4j-tpu-tuning-v1"
+    assert rec["space_version"] == 7
+    assert any(c["pruned_by"] for c in rec["candidates"])
+
+
+def test_search_numerics_mismatch_cannot_win():
+    """A faster candidate whose outputs differ from the default's is
+    excluded from winning — tuning changes speed, never results."""
+    times = {1: 0.010, 2: 0.002}
+
+    def measure_fn(cfg):
+        return times[cfg["x"]], ("ref" if cfg["x"] == 1 else "DIFFERENT")
+
+    res = search(_syn_space(candidates=(1, 2)), {}, {"x": 1},
+                 lambda cfg: None, measure_fn, repeats=3)
+    assert res.winner_config == {"x": 1}
+    assert res.tuned_vs_default == pytest.approx(1.0)
+    rec2 = next(r for r in res.candidates if r.config == {"x": 2})
+    assert rec2.measured and rec2.numerics_match is False and not rec2.winner
+
+
+def test_search_compile_none_keeps_candidate_on_frontier():
+    """Host-side knobs (no per-config executable) skip pruning but are
+    still measured."""
+    times = {1: 0.010, 2: 0.004}
+    res = search(_syn_space(candidates=(1, 2)), {}, {"x": 1},
+                 lambda cfg: None, lambda cfg: (times[cfg["x"]], "ok"),
+                 repeats=3)
+    assert res.counts["profiled"] == 0 and res.counts["pruned"] == 0
+    assert res.counts["measured"] == 2
+    assert res.winner_config == {"x": 2}
+
+
+def test_search_injects_missing_default_and_rejects_invalid_default():
+    res = search(_syn_space(candidates=(1, 2)), {}, {"x": 99},
+                 lambda cfg: None,
+                 lambda cfg: (0.01 if cfg["x"] == 99 else 0.02, "ok"),
+                 repeats=3)
+    assert res.counts["total"] == 3
+    assert res.winner_config == {"x": 99}
+
+    with pytest.raises(ValueError, match="default config"):
+        search(_syn_space(candidates=(1, 2),
+                          validity=lambda cfg, ctx: "no"), {}, {"x": 1},
+               lambda cfg: None, lambda cfg: (0.01, "ok"))
+
+
+def test_spearman_basics():
+    assert spearman([1.0, 2.0, 3.0], [10.0, 20.0, 30.0]) == pytest.approx(1.0)
+    assert spearman([1.0, 2.0, 3.0], [3.0, 2.0, 1.0]) == pytest.approx(-1.0)
+    assert spearman([1.0, 1.0, 1.0], [1.0, 2.0, 3.0]) is None
+    assert spearman([1.0], [1.0]) is None
+
+
+# -------------------------------------------------------------- cache ----
+
+_CTX = {"kind": "lm", "d_model": 64, "n_heads": 2, "mesh": (2, 4),
+        "backend": "cpu"}
+
+
+def test_fingerprint_is_shape_sensitive_and_order_stable():
+    assert fingerprint(_CTX) == fingerprint(dict(reversed(list(
+        _CTX.items()))))
+    # tuples and lists canonicalize identically (JSON has no tuples)
+    assert fingerprint(_CTX) == fingerprint({**_CTX, "mesh": [2, 4]})
+    for key, val in (("d_model", 128), ("mesh", (4, 2)), ("backend", "tpu")):
+        assert fingerprint({**_CTX, key: val}) != fingerprint(_CTX)
+
+
+def test_cache_store_lookup_hit_and_shape_miss(tmp_path):
+    cache = TuningCache(str(tmp_path / "cache.json"))
+    key = cache.store("flash_attention", _CTX, {"block_q": 64, "block_k": 64})
+    assert key == f"flash_attention:{fingerprint(_CTX)}"
+    assert cache.lookup("flash_attention", _CTX) == {"block_q": 64,
+                                                     "block_k": 64}
+    # any shape change is a miss, never a silent adoption
+    assert cache.lookup("flash_attention", {**_CTX, "d_model": 128}) is None
+    assert cache.lookup("flash_attention", {**_CTX, "mesh": (4, 2)}) is None
+    assert cache.lookup("flash_attention", {**_CTX, "backend": "tpu"}) is None
+    assert cache.lookup("serve", _CTX) is None  # seam keys the entry too
+
+
+def test_corrupt_cache_is_ignored_loudly(tmp_path, caplog):
+    path = tmp_path / "cache.json"
+    path.write_text("{this is not json", encoding="utf-8")
+    cache = TuningCache(str(path), registry=MetricsRegistry())
+    with caplog.at_level(logging.WARNING,
+                         logger="deeplearning4j_tpu.tune.cache"):
+        assert cache.lookup("flash_attention", _CTX) is None
+    assert any("unreadable" in r.message for r in caplog.records)
+    # an alien schema warns too (never a crash, never silent)
+    path.write_text(json.dumps({"schema": "someone-elses", "entries": {}}),
+                    encoding="utf-8")
+    caplog.clear()
+    with caplog.at_level(logging.WARNING,
+                         logger="deeplearning4j_tpu.tune.cache"):
+        assert cache.lookup("flash_attention", _CTX) is None
+    assert any("unexpected schema" in r.message for r in caplog.records)
+    # a store after corruption rebuilds a valid file
+    cache.store("flash_attention", _CTX, {"block_q": 64, "block_k": 64})
+    assert cache.lookup("flash_attention", _CTX) is not None
+
+
+def test_stale_space_version_misses_and_sets_gauge(tmp_path, caplog):
+    reg = MetricsRegistry()
+    path = tmp_path / "cache.json"
+    cache = TuningCache(str(path), registry=reg)
+    cache.store("flash_attention", _CTX, {"block_q": 64, "block_k": 64})
+    # simulate a knob-space bump since the search ran
+    data = json.loads(path.read_text())
+    for entry in data["entries"].values():
+        entry["space_version"] = 999
+    path.write_text(json.dumps(data), encoding="utf-8")
+    with caplog.at_level(logging.WARNING,
+                         logger="deeplearning4j_tpu.tune.cache"):
+        assert cache.lookup("flash_attention", _CTX) is None
+    assert any("stale" in r.message for r in caplog.records)
+    # the watchtower signal (alert rule tune_cache_stale fires on > 0)
+    assert reg.gauge("tune_cache_stale_entries").value == 1.0
+    assert cache.stale_count() == 1
+
+
+def test_concurrent_store_and_lookup_under_lockwatch(tmp_path, lockwatch):
+    """8 threads hammer store+lookup on one cache file: every entry lands,
+    the file never tears, and the lockwatch cycle detector (armed by the
+    fixture, raise-on-cycle) sees no lock-order inversion."""
+    cache = TuningCache(str(tmp_path / "cache.json"))
+    errors = []
+
+    def worker(i):
+        try:
+            for j in range(5):
+                ctx = {**_CTX, "d_model": 64 + i * 10 + j}
+                cache.store("flash_attention", ctx,
+                            {"block_q": 64, "block_k": 64 * (1 + j % 2)})
+                got = cache.lookup("flash_attention", ctx)
+                assert got is not None
+                cache.entries()
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert len(cache.entries()) == 40
+    # the file on disk is a single valid JSON document (atomic writes)
+    data = json.loads((tmp_path / "cache.json").read_text())
+    assert len(data["entries"]) == 40
+
+
+def test_resolve_tuned_precedence(tmp_path, monkeypatch):
+    cache = TuningCache(str(tmp_path / "cache.json"))
+    cache.store("serve", _CTX, {"min_bucket": 4, "slots": 8})
+    # explicit dict outranks everything (no cache read)
+    assert resolve_tuned({"slots": 2}, "serve", _CTX, cache) == {"slots": 2}
+    # False = hard off
+    assert resolve_tuned(False, "serve", _CTX, cache) is None
+    # None + env unset = off
+    assert resolve_tuned(None, "serve", _CTX, cache) is None
+    # None + env set = cache
+    monkeypatch.setenv("DL4J_TPU_TUNED", "1")
+    assert resolve_tuned(None, "serve", _CTX, cache) == {"min_bucket": 4,
+                                                         "slots": 8}
+    # True = cache regardless of env
+    monkeypatch.delenv("DL4J_TPU_TUNED")
+    assert resolve_tuned(True, "serve", _CTX, cache) == {"min_bucket": 4,
+                                                         "slots": 8}
+    with pytest.raises(TypeError):
+        resolve_tuned(3.14, "serve", _CTX, cache)
+
+
+def test_resolve_step_tuning_contract(monkeypatch):
+    assert resolve_step_tuning({"block_q": 64}, None,
+                               ("flash_attention",)) == {"block_q": 64}
+    assert resolve_step_tuning(False, _CTX, ("flash_attention",)) == {}
+    # tuned=True without a context is a programming error: cache keys are
+    # shape-fingerprinted, an improvised lookup would just always miss
+    with pytest.raises(ValueError, match="tune_context"):
+        resolve_step_tuning(True, None, ("flash_attention",))
+    # the env gate without a context quietly resolves to defaults
+    monkeypatch.setenv("DL4J_TPU_TUNED", "1")
+    assert resolve_step_tuning(None, None, ("flash_attention",)) == {}
+
+
+# --------------------------------------- tuned-adoption numerics pins ----
+
+_V, _D, _H, _E, _DFF = 32, 16, 2, 4, 32
+
+
+def _lm_params(n_layers=1, n_experts=_E):
+    from deeplearning4j_tpu.models.transformer_lm import init_lm_params
+    return init_lm_params(jax.random.PRNGKey(0), _V, _D, _H, n_experts,
+                          _DFF, n_layers=n_layers)
+
+
+def _lm_data(b, t, seed=1):
+    toks = jax.random.randint(jax.random.PRNGKey(seed), (b, t + 1), 0, _V)
+    return toks[:, :-1], toks[:, 1:]
+
+
+def _tree_max_abs_diff(a, b):
+    return max(float(jnp.max(jnp.abs(jnp.asarray(x, jnp.float32)
+                                     - jnp.asarray(y, jnp.float32))))
+               for x, y in zip(jax.tree_util.tree_leaves(a),
+                               jax.tree_util.tree_leaves(b)))
+
+
+def test_single_device_tuned_blocks_parity_1e5():
+    """tuned={block_q, block_k} on the single-device step: loss AND params
+    within 1e-5 of the default block policy over 3 SGD steps (reduction
+    order moves with the tiling, so the pin is allclose, not bitwise)."""
+    from deeplearning4j_tpu.models.transformer_lm import (
+        make_single_device_train_step,
+    )
+
+    toks, tgts = _lm_data(2, 128)
+    default = make_single_device_train_step(_H, attn_impl="blockwise")
+    tuned = make_single_device_train_step(
+        _H, attn_impl="blockwise", tuned={"block_q": 64, "block_k": 64})
+    p_d, p_t = _lm_params(), _lm_params()
+    for i in range(3):
+        p_d, l_d = default(p_d, toks, tgts)
+        p_t, l_t = tuned(p_t, toks, tgts)
+        assert abs(float(l_d) - float(l_t)) < 1e-5, (i, float(l_d),
+                                                     float(l_t))
+    assert _tree_max_abs_diff(p_d, p_t) < 1e-5
+
+
+def test_composed_tuned_alltoall_2d_bitwise():
+    """tuned={moe_impl: alltoall_2d} on the dp2xep4 composed step is
+    BITWISE identical to the default flat-alltoall step — the same pin
+    test_moe carries for the raw dispatchers, here through the cache-
+    adoption seam (capacity_factor=1.0 keeps capacity untouched)."""
+    from deeplearning4j_tpu.models.transformer_lm import (
+        make_composed_train_step,
+        shard_lm_batch,
+        shard_lm_params,
+    )
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                ("data", "expert"))
+    b, t = 4, 16
+    capacity = (b // 2) * t
+    toks, tgts = _lm_data(b, t)
+    stoks, stgts = shard_lm_batch(toks, tgts, mesh)
+    default = make_composed_train_step(mesh, _H, capacity)
+    tuned = make_composed_train_step(
+        mesh, _H, capacity,
+        tuned={"moe_impl": "alltoall_2d", "capacity_factor": 1.0})
+    p_d = shard_lm_params(_lm_params(), mesh)
+    p_t = shard_lm_params(_lm_params(), mesh)
+    for _ in range(2):
+        p_d, l_d = default(p_d, stoks, stgts)
+        jax.block_until_ready(l_d)
+        p_t, l_t = tuned(p_t, stoks, stgts)
+        jax.block_until_ready(l_t)
+        assert float(l_d) == float(l_t)
+    for a, c in zip(jax.tree_util.tree_leaves(jax.device_get(p_d)),
+                    jax.tree_util.tree_leaves(jax.device_get(p_t))):
+        assert np.array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_pipeline_tuned_overlap_bitwise():
+    """tuned={overlap: True} through the pipeline factory's seam is
+    bitwise identical (loss AND params) to the strict-tick default —
+    the ISSUE 14 overlap guarantee, re-pinned through cache adoption."""
+    from deeplearning4j_tpu.parallel.pipeline import (
+        PIPE_AXIS,
+        make_pipeline_train_step,
+        shard_stage_params,
+        stack_stage_params,
+    )
+    from jax.sharding import Mesh
+
+    d, n_stages, n_micro, mb = 8, 4, 8, 2
+    mesh = Mesh(np.array(jax.devices()[:n_stages]), (PIPE_AXIS,))
+    ks = jax.random.split(jax.random.PRNGKey(3), n_stages)
+    per_stage = [{"w": jax.random.normal(k, (d, d)) / np.sqrt(d),
+                  "b": jnp.zeros((d,))} for k in ks]
+    stage_fn = lambda p, x: jnp.tanh(x @ p["w"] + p["b"])  # noqa: E731
+    loss_fn = lambda y, tt: jnp.mean((y - tt) ** 2)  # noqa: E731
+    x = jax.random.normal(jax.random.PRNGKey(4), (n_micro, mb, d))
+    tgt = jnp.tanh(jax.random.normal(jax.random.PRNGKey(5),
+                                     (n_micro, mb, d)))
+    stacked = shard_stage_params(stack_stage_params(per_stage), mesh)
+    strict = make_pipeline_train_step(stage_fn, loss_fn, mesh, lr=0.2)
+    tuned = make_pipeline_train_step(
+        stage_fn, loss_fn, mesh, lr=0.2,
+        tuned={"microbatches": n_micro, "overlap": True})
+    p_s = jax.tree_util.tree_map(jnp.array, stacked)
+    p_t = jax.tree_util.tree_map(jnp.array, stacked)
+    for _ in range(3):
+        p_s, l_s = strict(p_s, x, tgt)
+        jax.block_until_ready(l_s)
+        p_t, l_t = tuned(p_t, x, tgt)
+        jax.block_until_ready(l_t)
+        assert float(l_s) == float(l_t)
+    for a, c in zip(jax.tree_util.tree_leaves(p_s),
+                    jax.tree_util.tree_leaves(p_t)):
+        assert jnp.array_equal(a, c)
+
+
+def test_engine_tuned_knobs_token_identical():
+    """tuned={min_bucket, slots} on DecodeEngine changes SCHEDULING only:
+    the greedy token streams match the default engine exactly, and the
+    knobs verifiably landed (slots/bucket observable on the engine)."""
+    from deeplearning4j_tpu.serve import DecodeEngine
+
+    params = _lm_params(n_layers=2, n_experts=2)
+    rng = np.random.RandomState(11)
+    prompts = [list(map(int, rng.randint(0, _V, rng.randint(3, 10))))
+               for _ in range(4)]
+    eng_d = DecodeEngine(params, _H, n_slots=2, max_len=32,
+                         serve_dtype=None, tuned=False)
+    eng_t = DecodeEngine(params, _H, n_slots=2, max_len=32,
+                         serve_dtype=None,
+                         tuned={"min_bucket": 4, "slots": 3})
+    assert eng_t.n_slots == 3 and eng_d.n_slots == 2
+    for p in prompts:
+        assert (eng_t.generate(p, max_new_tokens=5)
+                == eng_d.generate(p, max_new_tokens=5)), p
+
+
+def test_engine_env_gate_adopts_cached_winner(tmp_path, monkeypatch):
+    """End-to-end cache adoption: a winner stored under the engine's OWN
+    context (serve_context of its param dims) is picked up via the
+    DL4J_TPU_TUNED env gate — proving the fingerprint the engine builds
+    matches the one the searcher stores under."""
+    from deeplearning4j_tpu.models.transformer_lm import lm_dims
+    from deeplearning4j_tpu.serve import DecodeEngine
+    from deeplearning4j_tpu.tune.seams import serve_context
+
+    params = _lm_params(n_layers=2, n_experts=2)
+    cache_path = str(tmp_path / "cache.json")
+    ctx = serve_context(lm_dims(params), _H, 32)
+    TuningCache(cache_path).store("serve", ctx,
+                                  {"min_bucket": 4, "slots": 5})
+    monkeypatch.setenv("DL4J_TPU_TUNE_CACHE", cache_path)
+    monkeypatch.setenv("DL4J_TPU_TUNED", "1")
+    eng = DecodeEngine(params, _H, n_slots=2, max_len=32, serve_dtype=None)
+    assert eng.n_slots == 5
+    # a different max_len is a different fingerprint -> defaults hold
+    eng2 = DecodeEngine(params, _H, n_slots=2, max_len=16, serve_dtype=None)
+    assert eng2.n_slots == 2

@@ -89,7 +89,8 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _METRIC_RE = re.compile(
     r"_(?:per_sec|per_chip|mfu|vs_cpu|vs_single|vs_densecore|vs_baseline|"
     r"blocking_vs_background|overhead_pct|peak_bytes_ratio|"
-    r"overlap_vs_strict|2d_vs_flat|prefetch_vs_rotate_after)$")
+    r"overlap_vs_strict|2d_vs_flat|prefetch_vs_rotate_after|"
+    r"tuned_vs_default)$")
 # metrics where an INCREASE is the regression (ISSUE 9 footprint rows,
 # ISSUE 10 serving-latency rows, ISSUE 14 stage wire-byte rows, ISSUE 16
 # inter-token-stream p99 rows)

@@ -4,7 +4,7 @@ per-stage roofline/attribution report (ISSUE 9).
 
 Usage:
     python tools/profile_report.py [--dir REPO] [--json] [--round N]
-                                   [--runtime PATH]
+                                   [--runtime PATH] [--tuning DIR]
 
 Data source: the ``BENCH_r*.json`` driver artifacts (same files
 tools/bench_report.py reads). Since ISSUE 9 the ``lm_composed`` stage and
@@ -38,6 +38,16 @@ measured MFU; a reconstructed partial dump is flagged ``PARTIAL`` with
 its torn-line count — the measured half beside the modeled half, so
 "the model says compute-bound" and "the run spent 40% in host" sit in
 one report.
+
+``--tuning DIR`` (ISSUE 20) renders the autotuner's pruning decisions:
+DIR is a decisions directory written by ``python -m
+deeplearning4j_tpu.tune --out DIR`` (one ``tuning_<seam>.json`` per
+searched seam). For every candidate the section shows its validity
+verdict, roofline position (implied compute/memory/comm seconds, the
+binding resource, peak/wire bytes), and — when it was pruned — WHICH
+config dominated it and on which cost components, so "why did my config
+never execute" is answerable after the fact. Winners and measured
+ratios ride along; ``tools/tune_report.py`` renders the summary tables.
 
 Exit code 0 with "no profile blobs" when the rounds predate ISSUE 9 —
 missing data is reported, never invented.
@@ -257,6 +267,79 @@ def render_runtime_text(sessions: List[Dict]) -> str:
     return "\n".join(lines)
 
 
+def load_tuning_decisions(path: str) -> List[Dict]:
+    """ISSUE 20: the searcher's decisions files (``tuning_<seam>.json``)
+    from a ``python -m deeplearning4j_tpu.tune --out`` directory (or one
+    file given directly). Unreadable files are skipped; an empty list
+    means "nothing to audit", reported downstream rather than invented."""
+    paths = (sorted(glob.glob(os.path.join(path, "tuning_*.json")))
+             if os.path.isdir(path) else [path])
+    decisions = []
+    for p in paths:
+        try:
+            with open(p) as fh:
+                rec = json.load(fh)
+        except (OSError, json.JSONDecodeError) as exc:
+            print(f"skipping unreadable tuning file {p}: {exc}",
+                  file=sys.stderr)
+            continue
+        if isinstance(rec, dict) and rec.get("candidates") is not None:
+            decisions.append(rec)
+    return decisions
+
+
+def render_tuning_text(decisions: List[Dict]) -> str:
+    """Candidates × (validity, roofline position, pruned-by reason)."""
+    if not decisions:
+        return ("no tuning decisions found — run "
+                "python -m deeplearning4j_tpu.tune --out <dir> first")
+    lines = ["", "autotuner pruning decisions (ISSUE 20):"]
+    for rec in decisions:
+        c = rec.get("counts") or {}
+        lines.append(
+            f"  seam {rec.get('seam')} (space v{rec.get('space_version')}): "
+            f"{c.get('total', 0)} candidates, {c.get('invalid', 0)} "
+            f"invalid, {c.get('pruned', 0)} pruned by dominance, "
+            f"{c.get('measured', 0)} measured")
+        lines.append(f"    {'config':<34} {'verdict':<10} {'bound':<8} "
+                     f"{'pred(s)':>9} {'peak':>9} {'wire':>9}  why")
+        for cand in rec.get("candidates") or []:
+            cfg = json.dumps(cand.get("config"), sort_keys=True)
+            cost = cand.get("cost") or {}
+            pred = cand.get("predicted_seconds")
+            pred_s = f"{pred:.3e}" if pred is not None else "-"
+            if cand.get("invalid_reason"):
+                verdict, why = "invalid", cand["invalid_reason"]
+            elif cand.get("pruned_by") is not None:
+                verdict = "pruned"
+                why = (f"dominated by "
+                       f"{json.dumps(cand['pruned_by'], sort_keys=True)}"
+                       + (f" ({cand.get('pruned_reason')})"
+                          if cand.get("pruned_reason") else ""))
+            elif cand.get("winner"):
+                r = cand.get("ratio_vs_default")
+                verdict = "WINNER"
+                why = (f"measured {r:.3f}x default"
+                       if r is not None else "measured")
+            elif cand.get("measured"):
+                r = cand.get("ratio_vs_default")
+                why = (f"measured {r:.3f}x default"
+                       if r is not None else "measured")
+                if cand.get("numerics_match") is False:
+                    why += " — NUMERICS MISMATCH, cannot win"
+                verdict = "measured"
+            else:
+                verdict, why = "frontier", "not measured"
+            lines.append(
+                f"    {cfg:<34} {verdict:<10} {cand.get('bound') or '-':<8} "
+                f"{pred_s:>9} {_fmt_bytes(cost.get('peak_bytes')):>9} "
+                f"{_fmt_bytes(cost.get('wire_bytes')):>9}  {why}")
+        if rec.get("rank_correlation") is not None:
+            lines.append(f"    predicted-vs-measured rank correlation: "
+                         f"{rec['rank_correlation']:.3f}")
+    return "\n".join(lines)
+
+
 def render_text(report: Dict) -> str:
     if not report["stages"]:
         return ("no profile blobs found in any BENCH_r*.json — rounds "
@@ -344,6 +427,11 @@ def main(argv=None) -> int:
                     help="runprof session dump (.json/.jsonl) or a "
                          "directory of them — renders the measured "
                          "runtime sections next to the AOT roofline")
+    ap.add_argument("--tuning", default=None, metavar="DIR",
+                    help="autotuner decisions dir (tuning_<seam>.json "
+                         "from python -m deeplearning4j_tpu.tune) — "
+                         "renders candidates, roofline position, and "
+                         "pruned-by-dominance reasons")
     args = ap.parse_args(argv)
     rounds = load_profile_rounds(args.dir)
     try:
@@ -359,12 +447,18 @@ def main(argv=None) -> int:
             print(f"cannot read runtime sessions: {exc}", file=sys.stderr)
             return 2
         report["runtime_sessions"] = sessions
+    decisions = None
+    if args.tuning is not None:
+        decisions = load_tuning_decisions(args.tuning)
+        report["tuning_decisions"] = decisions
     if args.json:
         print(json.dumps(report, indent=1))
     else:
         print(render_text(report))
         if sessions is not None:
             print(render_runtime_text(sessions))
+        if decisions is not None:
+            print(render_tuning_text(decisions))
     return 0
 
 

@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Summarize an autotuner run (ISSUE 20): per-knob winner table,
+pruned/measured counts, predicted-vs-measured rank correlation, and the
+tuning cache's current entries.
+
+Usage:
+    python tools/tune_report.py [DIR] [--cache PATH] [--json]
+
+DIR is a decisions directory written by ``python -m
+deeplearning4j_tpu.tune --out DIR`` (default: ``tuning_out``). For each
+searched seam the report shows every knob's default vs winning value,
+how much of the space the roofline pruner disposed of without executing
+anything, the measured tuned-vs-default speedup, and the Spearman rank
+correlation between the cost model's predicted ordering and the
+measured one — the number that says whether phase 1's pruning can be
+trusted. ``--cache`` additionally lists the tuning cache's entries with
+their knob-space versions, flagging stale ones (the watchtower
+``tune_cache_stale`` signal, readable offline).
+
+The per-candidate audit trail (who pruned whom and why) lives in
+``tools/profile_report.py --tuning DIR``.
+
+Exit code 0 with a "no decisions" message when DIR is empty — missing
+data is reported, never invented.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_decisions(path: str) -> List[Dict]:
+    paths = (sorted(glob.glob(os.path.join(path, "tuning_*.json")))
+             if os.path.isdir(path) else [path])
+    out = []
+    for p in paths:
+        try:
+            with open(p) as fh:
+                rec = json.load(fh)
+        except (OSError, json.JSONDecodeError) as exc:
+            print(f"skipping unreadable tuning file {p}: {exc}",
+                  file=sys.stderr)
+            continue
+        if isinstance(rec, dict) and rec.get("winner_config") is not None:
+            out.append(rec)
+    return out
+
+
+def build_report(decisions: List[Dict]) -> Dict:
+    seams = []
+    for rec in decisions:
+        default = rec.get("default_config") or {}
+        winner = rec.get("winner_config") or {}
+        knobs = [{
+            "knob": k,
+            "default": default.get(k),
+            "winner": winner.get(k),
+            "changed": winner.get(k) != default.get(k),
+        } for k in sorted(set(default) | set(winner))]
+        seams.append({
+            "seam": rec.get("seam"),
+            "space_version": rec.get("space_version"),
+            "context": rec.get("context"),
+            "knobs": knobs,
+            "tuned_vs_default": rec.get("tuned_vs_default"),
+            "counts": rec.get("counts") or {},
+            "rank_correlation": rec.get("rank_correlation"),
+        })
+    return {"seams": seams}
+
+
+def load_cache_entries(path: str) -> List[Dict]:
+    """Cache entries + staleness verdicts via the library (the live
+    ``space_version`` is the comparison anchor)."""
+    sys.path.insert(0, REPO_ROOT)
+    from deeplearning4j_tpu.tune.cache import TuningCache  # noqa: E402
+    from deeplearning4j_tpu.tune.space import (  # noqa: E402
+        space_names,
+        space_version,
+    )
+
+    live = {s: space_version(s) for s in space_names()}
+    rows = []
+    for key, entry in sorted(TuningCache(path).entries().items()):
+        seam = entry.get("seam")
+        rows.append({
+            "key": key,
+            "seam": seam,
+            "config": entry.get("config"),
+            "space_version": entry.get("space_version"),
+            "live_version": live.get(seam),
+            "stale": (seam in live
+                      and entry.get("space_version") != live[seam]),
+        })
+    return rows
+
+
+def render_text(report: Dict, cache_rows=None) -> str:
+    if not report["seams"]:
+        return ("no tuning decisions found — run "
+                "python -m deeplearning4j_tpu.tune --out <dir> first")
+    lines = ["autotuner summary (ISSUE 20):"]
+    for s in report["seams"]:
+        c = s["counts"]
+        ratio = s["tuned_vs_default"]
+        corr = s["rank_correlation"]
+        lines.append(
+            f"\nseam {s['seam']} (space v{s['space_version']}): "
+            f"tuned-vs-default "
+            + (f"{ratio:.3f}x" if ratio is not None else "-")
+            + f" | {c.get('total', 0)} candidates -> "
+              f"{c.get('invalid', 0)} invalid, {c.get('pruned', 0)} pruned "
+              f"without executing, {c.get('measured', 0)} measured"
+            + (f" | rank corr {corr:.3f}" if corr is not None else ""))
+        lines.append(f"  {'knob':<18} {'default':>10} {'winner':>10}")
+        for k in s["knobs"]:
+            mark = "  <-- tuned" if k["changed"] else ""
+            lines.append(f"  {k['knob']:<18} {str(k['default']):>10} "
+                         f"{str(k['winner']):>10}{mark}")
+    if cache_rows is not None:
+        lines.append("\ntuning cache entries:")
+        if not cache_rows:
+            lines.append("  (empty)")
+        for row in cache_rows:
+            flag = (f"  <-- STALE (live v{row['live_version']})"
+                    if row["stale"] else "")
+            lines.append(f"  {row['key']:<40} v{row['space_version']} "
+                         f"{json.dumps(row['config'], sort_keys=True)}"
+                         f"{flag}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dir", nargs="?", default="tuning_out",
+                    help="decisions directory (default: tuning_out)")
+    ap.add_argument("--cache", default=None, metavar="PATH",
+                    help="also list this tuning cache's entries with "
+                         "staleness verdicts")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    report = build_report(load_decisions(args.dir))
+    cache_rows = None
+    if args.cache is not None:
+        cache_rows = load_cache_entries(args.cache)
+        report["cache_entries"] = cache_rows
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(render_text(report, cache_rows))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
